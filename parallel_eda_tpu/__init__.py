@@ -1,0 +1,22 @@
+"""parallel_eda_tpu — a TPU-native FPGA place-and-route framework.
+
+A from-scratch re-design of the capabilities of chinhau5/parallel_eda (a
+research fork of VPR 7.0 with a large family of parallel PathFinder routers)
+for TPU hardware: JAX/XLA for all hot compute (batched wavefront routing,
+vmapped simulated-annealing moves, levelized static timing analysis), with
+`jax.sharding.Mesh` + `shard_map` + XLA collectives replacing the reference's
+TBB/pthreads/MPI communication backends.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+  arch/     — architecture + device model     (ref: libarchfpga/)
+  netlist/  — BLIF + packed netlist + file IO (ref: vpr/SRC/base readers)
+  pack/     — greedy clustering               (ref: vpr/SRC/pack)
+  place/    — simulated-annealing placer      (ref: vpr/SRC/place)
+  rr/       — routing-resource graph as CSR   (ref: vpr/SRC/route/rr_graph.c)
+  route/    — PathFinder negotiated routing   (ref: vpr/SRC/route + parallel_route)
+  timing/   — static timing analysis          (ref: vpr/SRC/timing)
+  parallel/ — mesh sharding + collectives     (ref: vpr/SRC/parallel_route MPI/TBB)
+  flow/     — CLI + flow orchestration        (ref: vpr/SRC/base/vpr_api.c)
+"""
+
+__version__ = "0.1.0"

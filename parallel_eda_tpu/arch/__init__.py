@@ -1,0 +1,11 @@
+from .model import (
+    Arch,
+    BlockType,
+    PinClass,
+    SegmentInf,
+    SwitchInf,
+    PIN_CLASS_DRIVER,
+    PIN_CLASS_RECEIVER,
+)
+from .builtin import k6_n10_arch, minimal_arch
+from .xml_parser import read_arch_xml

@@ -1,0 +1,57 @@
+"""Built-in architectures.
+
+The driver's config ladder (BASELINE.md) starts at ``k6_N10_40nm``; we ship a
+built-in equivalent so the flow runs without an XML file.  Numbers are in the
+ballpark of the VTR 40 nm models (not copied from any file in the reference
+tree — the reference bundles no arch XMLs).
+"""
+
+from __future__ import annotations
+
+from .model import Arch, SegmentInf, SwitchInf, make_clb_type, make_io_type
+
+
+def k6_n10_arch() -> Arch:
+    """K=6, N=10, I=33 soft-logic architecture, single wire type (length 1),
+    buffered switches.  Stand-in for the k6_N10_40nm VTR arch."""
+    arch = Arch(
+        name="k6_N10",
+        K=6, N=10, I=33, io_capacity=8,
+        segments=[SegmentInf(name="l1", length=1, frequency=1.0,
+                             Rmetal=101.0, Cmetal=22.5e-15,
+                             wire_switch=0, opin_switch=1)],
+        switches=[
+            SwitchInf(name="wire_mux", buffered=True, R=551.0,
+                      Cin=7.7e-15, Cout=12.9e-15, Tdel=58e-12),
+            SwitchInf(name="opin_buf", buffered=True, R=551.0,
+                      Cin=7.7e-15, Cout=12.9e-15, Tdel=75e-12),
+        ],
+        Fc_out=0.1, Fc_in=0.15,
+        ipin_switch=0,
+        default_chan_width=40,
+    )
+    arch.block_types = [
+        make_io_type(index=0, capacity=arch.io_capacity),
+        make_clb_type(index=1, K=arch.K, N=arch.N, I=arch.I,
+                      T_comb=261e-12, T_setup=66e-12, T_clk_to_q=124e-12),
+    ]
+    return arch
+
+
+def minimal_arch(K: int = 4, N: int = 2, I: int = 6,
+                 io_capacity: int = 2, chan_width: int = 12) -> Arch:
+    """Tiny architecture for tests: small CLBs so rr-graphs stay small."""
+    arch = Arch(
+        name="minimal",
+        K=K, N=N, I=I, io_capacity=io_capacity,
+        segments=[SegmentInf()],
+        switches=[SwitchInf(), SwitchInf(name="opin_buf", Tdel=70e-12)],
+        Fc_out=0.5, Fc_in=0.5,
+        ipin_switch=0,
+        default_chan_width=chan_width,
+    )
+    arch.block_types = [
+        make_io_type(index=0, capacity=io_capacity),
+        make_clb_type(index=1, K=K, N=N, I=I),
+    ]
+    return arch

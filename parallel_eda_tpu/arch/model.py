@@ -1,0 +1,175 @@
+"""Architecture / device model.
+
+TPU-native equivalent of the reference's ``libarchfpga`` layer: the structs
+``t_arch`` / ``t_type_descriptor`` / ``t_segment_inf`` / ``t_switch_inf``
+(reference: libarchfpga/include/physical_types.h) re-designed as plain Python
+dataclasses.  This layer is host-only: it feeds the rr-graph builder, which
+emits flat device arrays; nothing here ever lands on the TPU directly.
+
+Design deviations from the reference (deliberate, TPU-first):
+  * Pin classes are flat arrays of pin indices, not linked structures; the
+    rr-graph builder vectorises over them with numpy.
+  * Only island-style grids (IO ring + columns of logic types), which covers
+    the k6_N10/Stratix-IV-like ladder in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Pin class directions (reference: libarchfpga physical_types.h e_pin_type)
+PIN_CLASS_RECEIVER = 0  # input pins
+PIN_CLASS_DRIVER = 1    # output pins
+
+
+@dataclass
+class SegmentInf:
+    """A routing wire segment type.
+
+    Reference: ``t_segment_inf`` (libarchfpga/include/physical_types.h),
+    consumed by build_rr_graph (vpr/SRC/route/rr_graph.c:385).
+    """
+    name: str = "l1"
+    length: int = 1            # logic blocks spanned per wire
+    frequency: float = 1.0     # fraction of channel tracks of this type
+    Rmetal: float = 100.0      # ohms per logic-block length
+    Cmetal: float = 20e-15     # farads per logic-block length
+    # index of the switch used between wires of this segment type
+    wire_switch: int = 0
+    opin_switch: int = 0
+
+
+@dataclass
+class SwitchInf:
+    """A routing switch (mux/buffer/pass transistor).
+
+    Reference: ``t_switch_inf`` (libarchfpga/include/physical_types.h);
+    used by the router's delay model (route/route_timing.c:663-672).
+    """
+    name: str = "mux0"
+    buffered: bool = True
+    R: float = 500.0
+    Cin: float = 5e-15
+    Cout: float = 5e-15
+    Tdel: float = 50e-12
+
+
+@dataclass
+class PinClass:
+    """An equivalence class of physical pins on a block type.
+
+    Reference: ``t_class`` (libarchfpga).  All pins in a class are logically
+    equivalent; SOURCE/SINK rr-nodes are created per class with
+    capacity == len(pins) (rr_graph.c alloc_and_load_rr_graph).
+    """
+    direction: int                 # PIN_CLASS_DRIVER or PIN_CLASS_RECEIVER
+    pins: List[int] = field(default_factory=list)
+    is_clock: bool = False
+
+
+@dataclass
+class BlockType:
+    """A placeable physical block type (CLB, IO, ...).
+
+    Reference: ``t_type_descriptor`` (libarchfpga/include/physical_types.h).
+    """
+    name: str
+    index: int
+    num_pins: int
+    capacity: int = 1               # placement sites per grid tile (IO > 1)
+    pin_classes: List[PinClass] = field(default_factory=list)
+    # pin -> class index
+    pin_class_of: List[int] = field(default_factory=list)
+    # pin -> side assignment handled uniformly by the rr builder (all pins
+    # accessible from all adjacent channels; VPR7's default pin_location
+    # "spread" is approximated as omni-side access).
+    is_io: bool = False
+    # Combinational delay through the block (input pin -> output pin), and
+    # sequential setup/clk-to-q.  Stand-ins for VPR7's <pb_type> delay matrix.
+    T_comb: float = 400e-12
+    T_setup: float = 60e-12
+    T_clk_to_q: float = 80e-12
+
+    @property
+    def num_input_pins(self) -> int:
+        return sum(len(c.pins) for c in self.pin_classes
+                   if c.direction == PIN_CLASS_RECEIVER and not c.is_clock)
+
+    @property
+    def num_output_pins(self) -> int:
+        return sum(len(c.pins) for c in self.pin_classes
+                   if c.direction == PIN_CLASS_DRIVER)
+
+
+@dataclass
+class Arch:
+    """Full device architecture.
+
+    Reference: ``t_arch`` built by XmlReadArch
+    (libarchfpga/read_xml_arch_file.c:2528).
+    """
+    name: str = "arch"
+    # logic cluster shape (AAPack target): N BLEs of K-LUT+FF each, I inputs
+    K: int = 6
+    N: int = 10
+    I: int = 33
+    io_capacity: int = 8
+    block_types: List[BlockType] = field(default_factory=list)
+    segments: List[SegmentInf] = field(default_factory=list)
+    switches: List[SwitchInf] = field(default_factory=list)
+    # fraction of channel tracks each OPIN / IPIN connects to
+    Fc_out: float = 0.25
+    Fc_in: float = 0.15
+    # IPIN mux delay (switch index used wire->IPIN)
+    ipin_switch: int = 0
+    # routing channel default width (overridden by --route_chan_width)
+    default_chan_width: int = 24
+
+    def block_type(self, name: str) -> BlockType:
+        for t in self.block_types:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    @property
+    def io_type(self) -> BlockType:
+        return next(t for t in self.block_types if t.is_io)
+
+    @property
+    def clb_type(self) -> BlockType:
+        return next(t for t in self.block_types if not t.is_io)
+
+
+def make_clb_type(index: int, K: int, N: int, I: int,
+                  T_comb: float = 400e-12,
+                  T_setup: float = 60e-12,
+                  T_clk_to_q: float = 80e-12) -> BlockType:
+    """Build a CLB block type: I input pins (one class), N output pins (one
+    class), 1 clock pin.  Mirrors the k6_N10 soft logic cluster."""
+    num_pins = I + N + 1
+    pin_classes = [
+        PinClass(PIN_CLASS_RECEIVER, list(range(0, I))),
+        PinClass(PIN_CLASS_DRIVER, list(range(I, I + N))),
+        PinClass(PIN_CLASS_RECEIVER, [I + N], is_clock=True),
+    ]
+    pin_class_of = [0] * I + [1] * N + [2]
+    return BlockType(
+        name="clb", index=index, num_pins=num_pins, capacity=1,
+        pin_classes=pin_classes, pin_class_of=pin_class_of, is_io=False,
+        T_comb=T_comb, T_setup=T_setup, T_clk_to_q=T_clk_to_q,
+    )
+
+
+def make_io_type(index: int, capacity: int) -> BlockType:
+    """IO block: one input pad pin (class 0, receiver — for outpads) and one
+    output pad pin (class 1, driver — for inpads), per site."""
+    pin_classes = [
+        PinClass(PIN_CLASS_RECEIVER, [0]),
+        PinClass(PIN_CLASS_DRIVER, [1]),
+    ]
+    return BlockType(
+        name="io", index=index, num_pins=2, capacity=capacity,
+        pin_classes=pin_classes, pin_class_of=[0, 1], is_io=True,
+        T_comb=0.0, T_setup=0.0, T_clk_to_q=0.0,
+    )

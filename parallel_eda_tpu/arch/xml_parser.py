@@ -1,0 +1,134 @@
+"""VPR7-style architecture XML reader (subset).
+
+TPU-native equivalent of ``XmlReadArch`` (reference:
+libarchfpga/read_xml_arch_file.c:2528, via the bundled ezxml parser).  We use
+the stdlib ElementTree and accept the subset of the VPR7 schema needed for the
+BASELINE.md ladder: <switchlist>, <segmentlist>, <complexblocklist> with an
+``io`` pb_type and one cluster pb_type, and <device><fc>.
+
+Anything unrecognised is ignored with a warning rather than rejected, so real
+VTR arch files load with approximated semantics (fracturable LUT modes etc.
+collapse to the K/N/I cluster summary, which is all the packer/placer/router
+layers consume).
+"""
+
+from __future__ import annotations
+
+import warnings
+import xml.etree.ElementTree as ET
+from typing import Optional
+
+from .model import Arch, SegmentInf, SwitchInf, make_clb_type, make_io_type
+
+
+def _f(attrib: dict, key: str, default: float) -> float:
+    try:
+        return float(attrib.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def read_arch_xml(path: str) -> Arch:
+    tree = ET.parse(path)
+    root = tree.getroot()
+    if root.tag != "architecture":
+        raise ValueError(f"{path}: root element is <{root.tag}>, "
+                         "expected <architecture>")
+
+    arch = Arch(name=path)
+
+    # --- switches (ref: ProcessSwitches, read_xml_arch_file.c) ---
+    switches = []
+    sl = root.find("switchlist")
+    if sl is not None:
+        for sw in sl.findall("switch"):
+            a = sw.attrib
+            switches.append(SwitchInf(
+                name=a.get("name", f"sw{len(switches)}"),
+                buffered=a.get("type", "mux") in ("mux", "buffer"),
+                R=_f(a, "R", 500.0),
+                Cin=_f(a, "Cin", 5e-15),
+                Cout=_f(a, "Cout", 5e-15),
+                Tdel=_f(a, "Tdel", 50e-12),
+            ))
+    if not switches:
+        switches = [SwitchInf()]
+    arch.switches = switches
+
+    def _switch_index(name: Optional[str]) -> int:
+        for i, s in enumerate(arch.switches):
+            if s.name == name:
+                return i
+        return 0
+
+    # --- segments (ref: ProcessSegments) ---
+    segments = []
+    segl = root.find("segmentlist")
+    if segl is not None:
+        for seg in segl.findall("segment"):
+            a = seg.attrib
+            mux = seg.find("mux")
+            wire_switch = _switch_index(mux.attrib.get("name")) if mux is not None else 0
+            segments.append(SegmentInf(
+                name=a.get("name", f"seg{len(segments)}"),
+                length=int(float(a.get("length", 1))),
+                frequency=_f(a, "freq", 1.0),
+                Rmetal=_f(a, "Rmetal", 100.0),
+                Cmetal=_f(a, "Cmetal", 20e-15),
+                wire_switch=wire_switch,
+                opin_switch=wire_switch,
+            ))
+    if not segments:
+        segments = [SegmentInf()]
+    arch.segments = segments
+
+    # --- device-level Fc defaults ---
+    dev = root.find("device")
+    # VPR7 puts <fc> under each pb_type; VPR8 under <device>. Accept both.
+    for fc in root.iter("fc"):
+        a = fc.attrib
+        if "default_in_val" in a:
+            arch.Fc_in = _f(a, "default_in_val", arch.Fc_in)
+            arch.Fc_out = _f(a, "default_out_val", arch.Fc_out)
+        else:
+            arch.Fc_in = _f(a, "in_val", arch.Fc_in)
+            arch.Fc_out = _f(a, "out_val", arch.Fc_out)
+        break
+
+    # --- complex blocks: extract io capacity + cluster K/N/I summary ---
+    io_capacity = 8
+    K, N, I = 6, 10, 33
+    cbl = root.find("complexblocklist")
+    if cbl is not None:
+        for pb in cbl.findall("pb_type"):
+            name = pb.attrib.get("name", "")
+            if name in ("io", "inpad", "outpad"):
+                io_capacity = int(float(pb.attrib.get("capacity", io_capacity)))
+                continue
+            # treat first non-io top-level pb_type as the logic cluster
+            num_in = sum(int(float(e.attrib.get("num_pins", 0)))
+                         for e in pb.findall("input"))
+            num_out = sum(int(float(e.attrib.get("num_pins", 0)))
+                          for e in pb.findall("output"))
+            if num_in:
+                I = num_in
+            if num_out:
+                N = num_out
+            # K from an inner LUT pb_type if present
+            for inner in pb.iter("pb_type"):
+                cls = inner.attrib.get("blif_model", "")
+                if cls == ".names":
+                    k_in = sum(int(float(e.attrib.get("num_pins", 0)))
+                               for e in inner.findall("input"))
+                    if k_in:
+                        K = k_in
+                    break
+    else:
+        warnings.warn(f"{path}: no <complexblocklist>; using k6_N10 defaults")
+
+    arch.K, arch.N, arch.I, arch.io_capacity = K, N, I, io_capacity
+    arch.block_types = [
+        make_io_type(index=0, capacity=io_capacity),
+        make_clb_type(index=1, K=K, N=N, I=I),
+    ]
+    return arch

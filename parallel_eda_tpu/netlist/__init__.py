@@ -1,0 +1,9 @@
+from .netlist import LogicalNetlist, Primitive, PRIM_INPAD, PRIM_OUTPAD, PRIM_LUT, PRIM_FF
+from .blif import read_blif, write_blif
+from .generate import generate_circuit
+from .packed import PackedNetlist, Block, ClbNet, NetPin
+from .files import (
+    write_net_file, read_net_file,
+    write_place_file, read_place_file,
+    write_route_file,
+)

@@ -1,0 +1,111 @@
+"""BLIF reader / writer.
+
+Equivalent of the reference's ``read_and_process_blif``
+(vpr/SRC/base/read_blif.c, called from vpr_api.c:228).  Supports the
+technology-mapped subset VPR consumes: .model/.inputs/.outputs/.names/.latch/
+.end, with line continuations.  Subcircuits and multiple models are rejected.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .netlist import (LogicalNetlist, Primitive,
+                      PRIM_INPAD, PRIM_OUTPAD, PRIM_LUT, PRIM_FF)
+
+
+def _logical_lines(text: str):
+    """Yield BLIF logical lines: strip comments, join '\\' continuations."""
+    pending = ""
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].rstrip()
+        if line.endswith("\\"):
+            pending += line[:-1] + " "
+            continue
+        line = (pending + line).strip()
+        pending = ""
+        if line:
+            yield line
+
+
+def read_blif(path: str, K: int = 6) -> LogicalNetlist:
+    with open(path) as f:
+        text = f.read()
+    return parse_blif(text, K=K, name=path)
+
+
+def parse_blif(text: str, K: int = 6, name: str = "blif") -> LogicalNetlist:
+    nl = LogicalNetlist(name=name)
+    cur_lut: Primitive = None
+    model_seen = False
+
+    def flush_lut():
+        nonlocal cur_lut
+        if cur_lut is not None:
+            nl.add(cur_lut)
+            cur_lut = None
+
+    for line in _logical_lines(text):
+        tok = line.split()
+        cmd = tok[0]
+        if cmd == ".model":
+            flush_lut()
+            if model_seen:
+                raise ValueError("multiple .model sections not supported")
+            model_seen = True
+            nl.name = tok[1] if len(tok) > 1 else name
+        elif cmd == ".inputs":
+            flush_lut()
+            for n in tok[1:]:
+                nl.add(Primitive(name=n, kind=PRIM_INPAD, output=n))
+        elif cmd == ".outputs":
+            flush_lut()
+            for n in tok[1:]:
+                nl.add(Primitive(name="out:" + n, kind=PRIM_OUTPAD, inputs=[n]))
+        elif cmd == ".names":
+            flush_lut()
+            *ins, out = tok[1:]
+            if len(ins) > K:
+                raise ValueError(f".names {out}: {len(ins)} inputs > K={K}")
+            cur_lut = Primitive(name=out, kind=PRIM_LUT,
+                                inputs=list(ins), output=out)
+        elif cmd == ".latch":
+            flush_lut()
+            # .latch <input> <output> [<type> <control>] [<init-val>]
+            d, q = tok[1], tok[2]
+            clock = None
+            if len(tok) >= 5:
+                clock = tok[4]
+            nl.add(Primitive(name=q, kind=PRIM_FF, inputs=[d], output=q,
+                             clock=clock))
+        elif cmd == ".end":
+            flush_lut()
+        elif cmd.startswith("."):
+            raise ValueError(f"unsupported BLIF construct: {cmd}")
+        else:
+            # truth table row for the pending .names
+            if cur_lut is None:
+                raise ValueError(f"stray truth-table row: {line}")
+            cur_lut.truth_table.append(line)
+    flush_lut()
+    nl.finalize()
+    return nl
+
+
+def write_blif(nl: LogicalNetlist, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(f".model {nl.name}\n")
+        ins = [p.output for p in nl.primitives if p.kind == PRIM_INPAD]
+        outs = [p.inputs[0] for p in nl.primitives if p.kind == PRIM_OUTPAD]
+        f.write(".inputs " + " ".join(ins) + "\n")
+        f.write(".outputs " + " ".join(outs) + "\n")
+        for p in nl.primitives:
+            if p.kind == PRIM_LUT:
+                f.write(".names " + " ".join(p.inputs + [p.output]) + "\n")
+                rows = p.truth_table or ["1" * len(p.inputs) + " 1"]
+                for r in rows:
+                    f.write(r + "\n")
+            elif p.kind == PRIM_FF:
+                clk = f" re {p.clock}" if p.clock else ""
+                f.write(f".latch {p.inputs[0]} {p.output}{clk} 2\n")
+        f.write(".end\n")

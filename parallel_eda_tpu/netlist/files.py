@@ -1,0 +1,129 @@
+"""Flow-interchange file IO: .net / .place / .route.
+
+Equivalent of the reference's readers/writers (vpr/SRC/base/read_netlist.c,
+read_place.c, route/route_common.c print_route).  These files are the
+checkpoint/resume surface of the flow (SURVEY.md §5.4): any stage can be
+restarted from them.  Formats follow VPR 7's text layouts closely enough to
+be diffable by eye; the .net file uses a compact JSON encoding rather than
+VPR7's XML (same information content).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..arch.model import Arch
+from .packed import Block, ClbNet, NetPin, PackedNetlist
+
+
+# ---------------------------------------------------------------- .net ----
+
+def write_net_file(pnl: PackedNetlist, path: str) -> None:
+    doc = {
+        "name": pnl.name,
+        "blocks": [
+            {"name": b.name, "type": b.type_name,
+             "pin_nets": b.pin_nets, "prims": b.prims}
+            for b in pnl.blocks
+        ],
+        "nets": [
+            {"name": n.name, "global": n.is_global} for n in pnl.nets
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def read_net_file(path: str, arch: Arch) -> PackedNetlist:
+    with open(path) as f:
+        doc = json.load(f)
+    pnl = PackedNetlist(name=doc["name"])
+    for n in doc["nets"]:
+        pnl.add_net(n["name"], is_global=n["global"])
+    for b in doc["blocks"]:
+        pnl.blocks.append(Block(name=b["name"], type_name=b["type"],
+                                pin_nets=list(b["pin_nets"]),
+                                prims=list(b.get("prims", []))))
+    pnl.bind_types(arch)
+    pnl.connect()
+    return pnl
+
+
+# -------------------------------------------------------------- .place ----
+
+def write_place_file(pnl: PackedNetlist, pos: np.ndarray,
+                     nx: int, ny: int, path: str,
+                     net_file: str = "-", arch_file: str = "-") -> None:
+    """``pos`` is [num_blocks, 3] int (x, y, subtile).
+
+    Format mirrors VPR's .place (base/read_place.c print_place).
+    """
+    with open(path, "w") as f:
+        f.write(f"Netlist file: {net_file}   Architecture file: {arch_file}\n")
+        f.write(f"Array size: {nx} x {ny} logic blocks\n\n")
+        f.write("#block name\tx\ty\tsubblk\tblock number\n")
+        f.write("#----------\t--\t--\t------\t------------\n")
+        for i, b in enumerate(pnl.blocks):
+            x, y, z = int(pos[i, 0]), int(pos[i, 1]), int(pos[i, 2])
+            f.write(f"{b.name}\t{x}\t{y}\t{z}\t#{i}\n")
+
+
+def read_place_file(pnl: PackedNetlist, path: str) -> Tuple[np.ndarray, int, int]:
+    name_to_idx = {b.name: i for i, b in enumerate(pnl.blocks)}
+    pos = np.zeros((len(pnl.blocks), 3), dtype=np.int32)
+    nx = ny = 0
+    seen = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or line.startswith("Netlist"):
+                continue
+            if line.startswith("Array size:"):
+                tok = line.split()
+                nx, ny = int(tok[2]), int(tok[4])
+                continue
+            tok = line.split()
+            if len(tok) < 4:
+                continue
+            bname = tok[0]
+            if bname not in name_to_idx:
+                raise ValueError(f"{path}: unknown block {bname}")
+            i = name_to_idx[bname]
+            pos[i] = [int(tok[1]), int(tok[2]), int(tok[3])]
+            seen += 1
+    if seen != len(pnl.blocks):
+        raise ValueError(f"{path}: {seen}/{len(pnl.blocks)} blocks placed")
+    return pos, nx, ny
+
+
+# -------------------------------------------------------------- .route ----
+
+_RR_TYPE_NAMES = ["SOURCE", "SINK", "OPIN", "IPIN", "CHANX", "CHANY"]
+
+
+def write_route_file(pnl: PackedNetlist, rr, routes: Dict[int, List[Tuple[int, int]]],
+                     path: str, nx: int, ny: int) -> None:
+    """``routes[net] = [(node, parent_node), ...]`` in tree order
+    (parent -1 for the root SOURCE).  Mirrors print_route
+    (vpr/SRC/route/route_common.c)."""
+    with open(path, "w") as f:
+        f.write(f"Array size: {nx} x {ny} logic blocks.\n\nRouting:\n")
+        for ni, net in enumerate(pnl.nets):
+            if net.is_global:
+                f.write(f"\nNet {ni} ({net.name}): global net\n")
+                continue
+            f.write(f"\nNet {ni} ({net.name})\n\n")
+            if ni not in routes:
+                continue
+            for node, parent in routes[ni]:
+                t = int(rr.node_type[node])
+                x, y = int(rr.xlow[node]), int(rr.ylow[node])
+                ptc = int(rr.ptc[node])
+                kind = _RR_TYPE_NAMES[t]
+                label = {0: "Class:", 1: "Class:", 2: "Pin:", 3: "Pin:",
+                         4: "Track:", 5: "Track:"}[t]
+                f.write(f"Node:\t{node}\t{kind} ({x},{y})  "
+                        f"{label} {ptc}  Parent: {parent}\n")

@@ -1,0 +1,1 @@
+from .packer import pack_netlist

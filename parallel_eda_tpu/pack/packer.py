@@ -1,0 +1,225 @@
+"""Greedy AAPack-style packer.
+
+TPU-native equivalent of the reference packing layer
+(vpr/SRC/pack/pack.c:20 try_pack → cluster.c:232 do_clustering, prepack.c
+molecule formation).  The reference runs this serially on the host and so do
+we — packing is pointer-chasing over small data and is never the bottleneck
+(SURVEY.md §7 step 5 ranks it lowest priority for TPU offload).
+
+Algorithm (same shape as AAPack, independently implemented):
+  1. BLE ("molecule") formation: a LUT absorbs the FF it feeds iff that FF is
+     the LUT's only fanout (prepack.c pattern-match equivalent); remaining
+     FFs become single-FF BLEs.
+  2. Seed-grow clustering: repeatedly seed a new cluster with the unclustered
+     BLE of highest fanin+fanout degree, then greedily add the BLE with the
+     highest attraction (shared-net count) subject to legality: ≤N BLEs,
+     ≤I distinct external input nets, single clock per cluster
+     (cluster_legality.c equivalent, enforced by construction).
+  3. Pin assignment + inter-cluster net extraction; clocks marked global.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..arch.model import Arch
+from ..netlist.netlist import (LogicalNetlist, PRIM_INPAD, PRIM_OUTPAD,
+                               PRIM_LUT, PRIM_FF)
+from ..netlist.packed import Block, PackedNetlist
+
+
+class _BLE:
+    __slots__ = ("lut", "ff", "inputs", "output", "clock")
+
+    def __init__(self, lut: Optional[int], ff: Optional[int],
+                 inputs: List[str], output: str, clock: Optional[str]):
+        self.lut = lut
+        self.ff = ff
+        self.inputs = inputs    # external input net names
+        self.output = output    # net name this BLE drives
+        self.clock = clock
+
+
+def _form_bles(nl: LogicalNetlist) -> List[_BLE]:
+    bles: List[_BLE] = []
+    absorbed_ff: Set[int] = set()
+    for i, p in enumerate(nl.primitives):
+        if p.kind != PRIM_LUT:
+            continue
+        sinks = nl.net_sinks.get(p.output, [])
+        ff = None
+        if len(sinks) == 1 and nl.primitives[sinks[0]].kind == PRIM_FF:
+            ff = sinks[0]
+            absorbed_ff.add(ff)
+        out = nl.primitives[ff].output if ff is not None else p.output
+        clock = nl.primitives[ff].clock if ff is not None else None
+        bles.append(_BLE(i, ff, list(p.inputs), out, clock))
+    for i, p in enumerate(nl.primitives):
+        if p.kind == PRIM_FF and i not in absorbed_ff:
+            bles.append(_BLE(None, i, list(p.inputs), p.output, p.clock))
+    return bles
+
+
+def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
+    N, I = arch.N, arch.I
+    clocks = set(nl.clocks)
+    bles = _form_bles(nl)
+    nble = len(bles)
+
+    # net -> producing/consuming BLE indices (over non-clock nets)
+    producers: Dict[str, int] = {}
+    consumers: Dict[str, List[int]] = {}
+    for bi, b in enumerate(bles):
+        producers[b.output] = bi
+        for n in b.inputs:
+            if n not in clocks:
+                consumers.setdefault(n, []).append(bi)
+
+    # adjacency weight = number of shared nets between BLE pairs
+    degree = [len(b.inputs) + len(consumers.get(b.output, [])) for b in bles]
+    unclustered = set(range(nble))
+    clusters: List[List[int]] = []
+
+    def attraction(cluster_bles: Set[int], cand: int) -> int:
+        score = 0
+        b = bles[cand]
+        for n in b.inputs:
+            p = producers.get(n)
+            if p is not None and p in cluster_bles:
+                score += 1
+        for c in consumers.get(b.output, []):
+            if c in cluster_bles:
+                score += 1
+        return score
+
+    def cluster_inputs(members: Set[int], cand: Optional[int] = None) -> int:
+        mem = set(members)
+        if cand is not None:
+            mem.add(cand)
+        outs = {bles[m].output for m in mem}
+        ext: Set[str] = set()
+        for m in mem:
+            for n in bles[m].inputs:
+                if n not in clocks and n not in outs:
+                    ext.add(n)
+        return len(ext)
+
+    while unclustered:
+        seed = max(unclustered, key=lambda b: (degree[b], -b))
+        members: Set[int] = {seed}
+        unclustered.remove(seed)
+        clk = bles[seed].clock
+        while len(members) < N:
+            # candidates: unclustered BLEs adjacent to the cluster
+            cands: Set[int] = set()
+            for m in members:
+                b = bles[m]
+                for n in b.inputs:
+                    p = producers.get(n)
+                    if p is not None and p in unclustered:
+                        cands.add(p)
+                for c in consumers.get(b.output, []):
+                    if c in unclustered:
+                        cands.add(c)
+            best, best_score = None, -1
+            for c in sorted(cands):
+                bc = bles[c]
+                if bc.clock is not None and clk is not None and bc.clock != clk:
+                    continue
+                if cluster_inputs(members, c) > I:
+                    continue
+                s = attraction(members, c)
+                if s > best_score:
+                    best, best_score = c, s
+            if best is None:
+                # fall back: any legal unclustered BLE (keeps clusters full,
+                # like AAPack's unrelated-clustering phase)
+                for c in sorted(unclustered):
+                    bc = bles[c]
+                    if bc.clock is not None and clk is not None and bc.clock != clk:
+                        continue
+                    if cluster_inputs(members, c) <= I:
+                        best = c
+                        break
+            if best is None:
+                break
+            members.add(best)
+            unclustered.remove(best)
+            if clk is None:
+                clk = bles[best].clock
+        clusters.append(sorted(members))
+
+    # ---- build the packed netlist ----
+    pnl = PackedNetlist(name=nl.name)
+    clb_t = arch.clb_type
+    io_t = arch.io_type
+
+    # which BLE outputs are needed outside their cluster
+    cluster_of_ble = {}
+    for ci, mem in enumerate(clusters):
+        for m in mem:
+            cluster_of_ble[m] = ci
+
+    pad_consumers: Dict[str, bool] = {}
+    for p in nl.primitives:
+        if p.kind == PRIM_OUTPAD:
+            pad_consumers[p.inputs[0]] = True
+
+    def net_needed_outside(ci: int, net: str) -> bool:
+        if net in pad_consumers:
+            return True
+        for c in consumers.get(net, []):
+            if cluster_of_ble[c] != ci:
+                return True
+        return False
+
+    # IO blocks first (inpads drive nets, outpads consume)
+    for i, p in enumerate(nl.primitives):
+        if p.kind == PRIM_INPAD:
+            ni = pnl.add_net(p.output, is_global=(p.output in clocks))
+            blk = Block(name=p.name, type_name=io_t.name,
+                        pin_nets=[-1, ni], prims=[i])
+            pnl.blocks.append(blk)
+        elif p.kind == PRIM_OUTPAD:
+            ni = pnl.add_net(p.inputs[0])
+            blk = Block(name=p.name, type_name=io_t.name,
+                        pin_nets=[ni, -1], prims=[i])
+            pnl.blocks.append(blk)
+
+    in_base = 0
+    out_base = arch.I
+    clk_pin = arch.I + arch.N
+    for ci, mem in enumerate(clusters):
+        pin_nets = [-1] * clb_t.num_pins
+        outs = {bles[m].output for m in mem}
+        ext_in: List[str] = []
+        clk = None
+        prims: List[int] = []
+        for m in mem:
+            b = bles[m]
+            if b.lut is not None:
+                prims.append(b.lut)
+            if b.ff is not None:
+                prims.append(b.ff)
+            if b.clock is not None:
+                clk = b.clock
+            for n in b.inputs:
+                if n not in clocks and n not in outs and n not in ext_in:
+                    ext_in.append(n)
+        assert len(ext_in) <= arch.I, "packer produced illegal cluster"
+        for k, n in enumerate(ext_in):
+            pin_nets[in_base + k] = pnl.add_net(n)
+        oidx = 0
+        for m in mem:
+            b = bles[m]
+            if net_needed_outside(ci, b.output):
+                pin_nets[out_base + oidx] = pnl.add_net(b.output)
+                oidx += 1
+        if clk is not None:
+            pin_nets[clk_pin] = pnl.add_net(clk, is_global=True)
+        pnl.blocks.append(Block(name=f"clb{ci}", type_name=clb_t.name,
+                                pin_nets=pin_nets, prims=sorted(prims)))
+
+    pnl.bind_types(arch)
+    pnl.connect()
+    return pnl

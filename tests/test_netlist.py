@@ -1,0 +1,106 @@
+import os
+import tempfile
+
+import pytest
+
+from parallel_eda_tpu.arch import minimal_arch, k6_n10_arch, read_arch_xml
+from parallel_eda_tpu.netlist import generate_circuit, read_blif, write_blif
+from parallel_eda_tpu.netlist.blif import parse_blif
+from parallel_eda_tpu.netlist import write_net_file, read_net_file
+from parallel_eda_tpu.pack import pack_netlist
+
+
+SMALL_BLIF = """
+# toy circuit
+.model toy
+.inputs a b c clk
+.outputs y
+.names a b t0
+11 1
+.names t0 c t1
+1- 1
+-1 1
+.latch t1 q re clk 2
+.names q t1 y
+11 1
+.end
+"""
+
+
+def test_parse_blif_roundtrip(tmp_path):
+    nl = parse_blif(SMALL_BLIF, K=6)
+    assert nl.num_luts == 3
+    assert nl.num_ffs == 1
+    assert nl.clocks == ["clk"]
+    p = tmp_path / "toy.blif"
+    write_blif(nl, str(p))
+    nl2 = read_blif(str(p))
+    assert nl2.num_luts == nl.num_luts
+    assert nl2.num_ffs == nl.num_ffs
+    assert set(nl2.net_driver) == set(nl.net_driver)
+
+
+def test_generate_circuit():
+    nl = generate_circuit(num_luts=50, seed=1)
+    assert nl.num_luts == 50
+    nl.finalize()  # idempotent
+
+
+def test_pack_small():
+    arch = minimal_arch()
+    nl = generate_circuit(num_luts=30, num_inputs=6, num_outputs=4,
+                          K=arch.K, seed=2)
+    pnl = pack_netlist(nl, arch)
+    clbs = [b for b in pnl.blocks if b.type_name == "clb"]
+    assert clbs, "no clusters produced"
+    # legality: every cluster respects I external inputs
+    for b in clbs:
+        ext = [n for p, n in enumerate(b.pin_nets[:arch.I]) if n >= 0]
+        assert len(ext) <= arch.I
+    # every non-global net has a driver and sinks resolved
+    for n in pnl.nets:
+        assert n.driver is not None
+
+
+def test_net_file_roundtrip(tmp_path):
+    arch = minimal_arch()
+    nl = generate_circuit(num_luts=20, K=arch.K, seed=3)
+    pnl = pack_netlist(nl, arch)
+    p = tmp_path / "c.net"
+    write_net_file(pnl, str(p))
+    pnl2 = read_net_file(str(p), arch)
+    assert len(pnl2.blocks) == len(pnl.blocks)
+    assert len(pnl2.nets) == len(pnl.nets)
+    for a, b in zip(pnl.nets, pnl2.nets):
+        assert a.name == b.name and a.num_sinks == b.num_sinks
+
+
+def test_arch_xml(tmp_path):
+    xml = """<architecture>
+  <switchlist>
+    <switch type="mux" name="0" R="551" Cin="7.7e-15" Cout="12.9e-15" Tdel="58e-12"/>
+  </switchlist>
+  <segmentlist>
+    <segment freq="1" length="1" Rmetal="101" Cmetal="22.5e-15"><mux name="0"/></segment>
+  </segmentlist>
+  <complexblocklist>
+    <pb_type name="io" capacity="8"/>
+    <pb_type name="clb">
+      <input name="I" num_pins="33"/>
+      <output name="O" num_pins="10"/>
+      <clock name="clk" num_pins="1"/>
+      <fc default_in_type="frac" default_in_val="0.15"
+          default_out_type="frac" default_out_val="0.1"/>
+      <pb_type name="ble"><pb_type name="lut" blif_model=".names">
+        <input name="in" num_pins="6"/><output name="out" num_pins="1"/>
+      </pb_type></pb_type>
+    </pb_type>
+  </complexblocklist>
+</architecture>"""
+    p = tmp_path / "arch.xml"
+    p.write_text(xml)
+    arch = read_arch_xml(str(p))
+    assert arch.K == 6 and arch.N == 10 and arch.I == 33
+    assert arch.io_capacity == 8
+    assert abs(arch.Fc_in - 0.15) < 1e-9
+    assert len(arch.switches) == 1
