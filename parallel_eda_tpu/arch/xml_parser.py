@@ -82,22 +82,24 @@ def read_arch_xml(path: str) -> Arch:
         segments = [SegmentInf()]
     arch.segments = segments
 
-    # --- device-level Fc defaults ---
-    dev = root.find("device")
-    # VPR7 puts <fc> under each pb_type; VPR8 under <device>. Accept both.
-    for fc in root.iter("fc"):
-        a = fc.attrib
-        if "default_in_val" in a:
-            arch.Fc_in = _f(a, "default_in_val", arch.Fc_in)
-            arch.Fc_out = _f(a, "default_out_val", arch.Fc_out)
-        else:
-            arch.Fc_in = _f(a, "in_val", arch.Fc_in)
-            arch.Fc_out = _f(a, "out_val", arch.Fc_out)
-        break
+    def _read_fc(scope) -> bool:
+        """Apply the first <fc> under ``scope``; VPR7 puts <fc> inside each
+        pb_type (default_*_val attrs), VPR8 under <device> (in/out_val)."""
+        for fc in scope.iter("fc"):
+            a = fc.attrib
+            if "default_in_val" in a:
+                arch.Fc_in = _f(a, "default_in_val", arch.Fc_in)
+                arch.Fc_out = _f(a, "default_out_val", arch.Fc_out)
+            else:
+                arch.Fc_in = _f(a, "in_val", arch.Fc_in)
+                arch.Fc_out = _f(a, "out_val", arch.Fc_out)
+            return True
+        return False
 
     # --- complex blocks: extract io capacity + cluster K/N/I summary ---
     io_capacity = 8
     K, N, I = 6, 10, 33
+    cluster_pb = None
     cbl = root.find("complexblocklist")
     if cbl is not None:
         for pb in cbl.findall("pb_type"):
@@ -105,17 +107,21 @@ def read_arch_xml(path: str) -> Arch:
             if name in ("io", "inpad", "outpad"):
                 io_capacity = int(float(pb.attrib.get("capacity", io_capacity)))
                 continue
-            # treat first non-io top-level pb_type as the logic cluster
+            # the first non-io top-level pb_type is the logic cluster; later
+            # ones (memory, mult, ...) don't override its geometry
+            if cluster_pb is None:
+                cluster_pb = pb
+        if cluster_pb is not None:
             num_in = sum(int(float(e.attrib.get("num_pins", 0)))
-                         for e in pb.findall("input"))
+                         for e in cluster_pb.findall("input"))
             num_out = sum(int(float(e.attrib.get("num_pins", 0)))
-                          for e in pb.findall("output"))
+                          for e in cluster_pb.findall("output"))
             if num_in:
                 I = num_in
             if num_out:
                 N = num_out
             # K from an inner LUT pb_type if present
-            for inner in pb.iter("pb_type"):
+            for inner in cluster_pb.iter("pb_type"):
                 cls = inner.attrib.get("blif_model", "")
                 if cls == ".names":
                     k_in = sum(int(float(e.attrib.get("num_pins", 0)))
@@ -125,6 +131,13 @@ def read_arch_xml(path: str) -> Arch:
                     break
     else:
         warnings.warn(f"{path}: no <complexblocklist>; using k6_N10 defaults")
+
+    # Fc: prefer the logic cluster's own <fc>; fall back to <device>.  The io
+    # pb_type's fc (typically 1.0) must never win, so no document-wide search.
+    dev = root.find("device")
+    if not (cluster_pb is not None and _read_fc(cluster_pb)):
+        if dev is not None:
+            _read_fc(dev)
 
     arch.K, arch.N, arch.I, arch.io_capacity = K, N, I, io_capacity
     arch.block_types = [

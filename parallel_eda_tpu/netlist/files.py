@@ -101,14 +101,15 @@ def read_place_file(pnl: PackedNetlist, path: str) -> Tuple[np.ndarray, int, int
 
 # -------------------------------------------------------------- .route ----
 
-_RR_TYPE_NAMES = ["SOURCE", "SINK", "OPIN", "IPIN", "CHANX", "CHANY"]
-
 
 def write_route_file(pnl: PackedNetlist, rr, routes: Dict[int, List[Tuple[int, int]]],
                      path: str, nx: int, ny: int) -> None:
     """``routes[net] = [(node, parent_node), ...]`` in tree order
     (parent -1 for the root SOURCE).  Mirrors print_route
     (vpr/SRC/route/route_common.c)."""
+    # imported here to keep netlist importable without the rr package
+    from ..rr.graph import RR_TYPE_NAMES, SOURCE, SINK, OPIN, IPIN
+
     with open(path, "w") as f:
         f.write(f"Array size: {nx} x {ny} logic blocks.\n\nRouting:\n")
         for ni, net in enumerate(pnl.nets):
@@ -122,8 +123,8 @@ def write_route_file(pnl: PackedNetlist, rr, routes: Dict[int, List[Tuple[int, i
                 t = int(rr.node_type[node])
                 x, y = int(rr.xlow[node]), int(rr.ylow[node])
                 ptc = int(rr.ptc[node])
-                kind = _RR_TYPE_NAMES[t]
-                label = {0: "Class:", 1: "Class:", 2: "Pin:", 3: "Pin:",
-                         4: "Track:", 5: "Track:"}[t]
+                kind = RR_TYPE_NAMES[t]
+                label = ("Class:" if t in (SOURCE, SINK)
+                         else "Pin:" if t in (OPIN, IPIN) else "Track:")
                 f.write(f"Node:\t{node}\t{kind} ({x},{y})  "
                         f"{label} {ptc}  Parent: {parent}\n")

@@ -1,0 +1,1 @@
+from .initial import initial_placement

@@ -1,0 +1,43 @@
+"""Initial placement.
+
+Equivalent of the reference's ``initial_placement`` (vpr/SRC/place/place.c:237):
+assign every packed block a legal (x, y, subtile) site — IOs onto perimeter
+sites, CLBs into the interior — either deterministically (round-robin, useful
+as a stable test fixture) or uniformly at random (the SA placer's starting
+point).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..netlist.packed import PackedNetlist
+from ..rr.grid import DeviceGrid
+
+
+def initial_placement(pnl: PackedNetlist, grid: DeviceGrid,
+                      seed: Optional[int] = None) -> np.ndarray:
+    """Returns pos [num_blocks, 3] int32 (x, y, subtile)."""
+    rng = np.random.default_rng(seed) if seed is not None else None
+
+    io_sites = [(x, y, z) for (x, y) in grid.io_sites()
+                for z in range(grid.io_capacity)]
+    clb_sites = [(x, y, 0) for (x, y) in grid.clb_sites()]
+    if rng is not None:
+        rng.shuffle(io_sites)
+        rng.shuffle(clb_sites)
+
+    pos = np.zeros((pnl.num_blocks, 3), dtype=np.int32)
+    io_i = clb_i = 0
+    for bi, b in enumerate(pnl.blocks):
+        if pnl.block_type(bi).is_io:
+            if io_i >= len(io_sites):
+                raise ValueError("not enough IO sites")
+            pos[bi] = io_sites[io_i]; io_i += 1
+        else:
+            if clb_i >= len(clb_sites):
+                raise ValueError("not enough CLB sites")
+            pos[bi] = clb_sites[clb_i]; clb_i += 1
+    return pos

@@ -1,0 +1,3 @@
+from .grid import DeviceGrid, size_grid
+from .graph import RRGraph, build_rr_graph, check_rr_graph
+from .terminals import net_terminals

@@ -1,0 +1,84 @@
+"""Device grid model + auto-sizing.
+
+Equivalent of the reference's grid setup (vpr/SRC/base/SetupGrid.c and the
+auto-size binary search in vpr/SRC/base/vpr_api.c:286-299): an island-style
+FPGA — an IO ring around a square interior of logic tiles.
+
+Coordinates follow the VPR convention: the grid is (nx+2) x (ny+2) tiles;
+tiles with x in [1, nx] and y in [1, ny] are logic (CLB) tiles; the perimeter
+(x==0, x==nx+1, y==0, y==ny+1) is IO, corners empty.  Routing channels:
+CHANX(x, y) is the horizontal channel above tile row y (x in [1, nx],
+y in [0, ny]); CHANY(x, y) is the vertical channel right of tile column x
+(x in [0, nx], y in [1, ny]).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from ..arch.model import Arch
+
+
+@dataclass
+class DeviceGrid:
+    nx: int
+    ny: int
+    io_capacity: int
+
+    @property
+    def width(self) -> int:
+        return self.nx + 2
+
+    @property
+    def height(self) -> int:
+        return self.ny + 2
+
+    def is_io(self, x: int, y: int) -> bool:
+        on_edge = x == 0 or x == self.nx + 1 or y == 0 or y == self.ny + 1
+        return on_edge and not self.is_corner(x, y)
+
+    def is_corner(self, x: int, y: int) -> bool:
+        return (x in (0, self.nx + 1)) and (y in (0, self.ny + 1))
+
+    def is_clb(self, x: int, y: int) -> bool:
+        return 1 <= x <= self.nx and 1 <= y <= self.ny
+
+    def io_sites(self) -> List[Tuple[int, int]]:
+        """Perimeter IO tile coordinates in clockwise order from (0,1).
+        Each holds ``io_capacity`` placement sites (subtiles)."""
+        sites = []
+        for y in range(1, self.ny + 1):              # left edge, bottom-up
+            sites.append((0, y))
+        for x in range(1, self.nx + 1):              # top edge, left-right
+            sites.append((x, self.ny + 1))
+        for y in range(self.ny, 0, -1):              # right edge, top-down
+            sites.append((self.nx + 1, y))
+        for x in range(self.nx, 0, -1):              # bottom edge, right-left
+            sites.append((x, 0))
+        return sites
+
+    def clb_sites(self) -> List[Tuple[int, int]]:
+        return [(x, y) for y in range(1, self.ny + 1)
+                for x in range(1, self.nx + 1)]
+
+
+def size_grid(num_clb: int, num_io: int, arch: Arch,
+              nx: int = 0, ny: int = 0) -> DeviceGrid:
+    """Smallest square grid fitting the design (binary-search equivalent of
+    vpr_api.c:286-299; closed form since the square case is monotone)."""
+    if nx and ny:
+        g = DeviceGrid(nx, ny, arch.io_capacity)
+    else:
+        n = 1
+        while True:
+            g = DeviceGrid(n, n, arch.io_capacity)
+            if (n * n >= num_clb
+                    and len(g.io_sites()) * arch.io_capacity >= num_io):
+                break
+            n += 1
+    if g.nx * g.ny < num_clb:
+        raise ValueError(f"grid {g.nx}x{g.ny} too small for {num_clb} CLBs")
+    if len(g.io_sites()) * g.io_capacity < num_io:
+        raise ValueError(f"grid {g.nx}x{g.ny} too small for {num_io} IOs")
+    return g

@@ -53,10 +53,21 @@ def test_pack_small():
     pnl = pack_netlist(nl, arch)
     clbs = [b for b in pnl.blocks if b.type_name == "clb"]
     assert clbs, "no clusters produced"
-    # legality: every cluster respects I external inputs
+    # legality: recompute each cluster's distinct external input nets from
+    # the logical netlist — nets consumed by a member prim but not produced
+    # inside the cluster and not a clock — and check against I
+    clocks = set(nl.clocks)
     for b in clbs:
-        ext = [n for p, n in enumerate(b.pin_nets[:arch.I]) if n >= 0]
+        produced = {nl.primitives[pi].output for pi in b.prims}
+        ext = set()
+        for pi in b.prims:
+            for net in nl.primitives[pi].inputs:
+                if net not in produced and net not in clocks:
+                    ext.add(net)
         assert len(ext) <= arch.I
+        # and the block's input pins agree with that recomputation
+        used_in_pins = sum(1 for n in b.pin_nets[:arch.I] if n >= 0)
+        assert used_in_pins == len(ext)
     # every non-global net has a driver and sinks resolved
     for n in pnl.nets:
         assert n.driver is not None
@@ -104,3 +115,33 @@ def test_arch_xml(tmp_path):
     assert arch.io_capacity == 8
     assert abs(arch.Fc_in - 0.15) < 1e-9
     assert len(arch.switches) == 1
+
+
+def test_arch_xml_extra_pbtypes_and_io_fc(tmp_path):
+    """Memory/mult pb_types after the cluster must not override K/N/I, and
+    the io pb_type's fc=1.0 must not win over the cluster's fc."""
+    xml = """<architecture>
+  <complexblocklist>
+    <pb_type name="io" capacity="4">
+      <fc default_in_type="frac" default_in_val="1.0"
+          default_out_type="frac" default_out_val="1.0"/>
+    </pb_type>
+    <pb_type name="clb">
+      <input name="I" num_pins="33"/>
+      <output name="O" num_pins="10"/>
+      <fc default_in_type="frac" default_in_val="0.15"
+          default_out_type="frac" default_out_val="0.1"/>
+    </pb_type>
+    <pb_type name="memory">
+      <input name="addr" num_pins="20"/>
+      <output name="data" num_pins="40"/>
+    </pb_type>
+  </complexblocklist>
+</architecture>"""
+    p = tmp_path / "arch.xml"
+    p.write_text(xml)
+    arch = read_arch_xml(str(p))
+    assert arch.N == 10 and arch.I == 33, "later pb_type overrode the cluster"
+    assert abs(arch.Fc_in - 0.15) < 1e-9, "io fc won over cluster fc"
+    assert abs(arch.Fc_out - 0.1) < 1e-9
+    assert arch.io_capacity == 4

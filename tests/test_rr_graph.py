@@ -1,0 +1,100 @@
+"""rr-graph builder tests (check_rr_graph.c-style invariants + structure)."""
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import minimal_arch, k6_n10_arch
+from parallel_eda_tpu.netlist.generate import generate_circuit
+from parallel_eda_tpu.pack.packer import pack_netlist
+from parallel_eda_tpu.place.initial import initial_placement
+from parallel_eda_tpu.rr.grid import DeviceGrid, size_grid
+from parallel_eda_tpu.rr.graph import (
+    build_rr_graph, check_rr_graph, SOURCE, SINK, OPIN, IPIN, CHANX, CHANY)
+from parallel_eda_tpu.rr.terminals import net_terminals
+
+
+def test_grid_sizing():
+    g = size_grid(num_clb=10, num_io=20, arch=minimal_arch())
+    assert g.nx * g.ny >= 10
+    assert len(g.io_sites()) * g.io_capacity >= 20
+    # perimeter count: 2*(nx+ny)
+    assert len(g.io_sites()) == 2 * (g.nx + g.ny)
+
+
+def test_rr_graph_minimal():
+    arch = minimal_arch(chan_width=8)
+    grid = DeviceGrid(3, 3, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    check_rr_graph(rr)
+
+    # node counts: wires = 2 rows/cols dirs * (n+1 rows) * W (L=1 wires split
+    # into nx pieces each)
+    n_chanx = int(np.sum(rr.node_type == CHANX))
+    n_chany = int(np.sum(rr.node_type == CHANY))
+    assert n_chanx == (grid.ny + 1) * 8 * grid.nx
+    assert n_chany == (grid.nx + 1) * 8 * grid.ny
+
+    # every CLB tile: 3 classes -> 1 SOURCE + 2 SINK(in+clk), N outs...
+    n_src = int(np.sum(rr.node_type == SOURCE))
+    # CLB: 1 driver class; IO tile: capacity * 1 driver class
+    n_io_tiles = len(grid.io_sites())
+    assert n_src == grid.nx * grid.ny + n_io_tiles * arch.io_capacity
+
+
+def test_rr_graph_length2_segments():
+    arch = minimal_arch(chan_width=8)
+    arch.segments[0].length = 2
+    grid = DeviceGrid(4, 4, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    check_rr_graph(rr)
+    # length-2 wires: spans of 2 except staggered ends
+    spans = (rr.xhigh - rr.xlow)[rr.node_type == CHANX] + 1
+    assert spans.max() == 2
+    assert spans.min() == 1  # staggered break at the edge
+
+
+def test_rr_graph_wire_spans_cover():
+    arch = minimal_arch(chan_width=4)
+    arch.segments[0].length = 3
+    grid = DeviceGrid(5, 5, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    check_rr_graph(rr)
+    # every (row, track, x) position covered by exactly one wire
+    chanx = np.where(rr.node_type == CHANX)[0]
+    for y in range(grid.ny + 1):
+        for t in range(4):
+            cover = np.zeros(grid.nx + 1, dtype=int)
+            for n in chanx:
+                if rr.ylow[n] == y and rr.ptc[n] == t:
+                    cover[rr.xlow[n]:rr.xhigh[n] + 1] += 1
+            assert np.all(cover[1:] == 1)
+
+
+def test_net_terminals():
+    arch = minimal_arch(chan_width=8)
+    nl = generate_circuit(num_luts=20, num_inputs=4, num_outputs=4,
+                          K=arch.K, seed=1, ff_ratio=0.4)
+    pnl = pack_netlist(nl, arch)
+    n_clb = sum(1 for b in pnl.blocks if b.type_name == "clb")
+    n_io = sum(1 for b in pnl.blocks if b.type_name == "io")
+    grid = size_grid(n_clb, n_io, arch)
+    pos = initial_placement(pnl, grid, seed=0)
+    rr = build_rr_graph(arch, grid)
+    term = net_terminals(pnl, rr, pos)
+
+    assert term.num_nets == len(pnl.routed_nets)
+    for r in range(term.num_nets):
+        assert rr.node_type[term.source[r]] == SOURCE
+        for s in range(term.num_sinks[r]):
+            assert rr.node_type[term.sinks[r, s]] == SINK
+        assert term.bb_xmin[r] <= term.bb_xmax[r]
+        # box contains source tile
+        assert term.bb_xmin[r] <= rr.xlow[term.source[r]] <= term.bb_xmax[r]
+
+
+def test_rr_graph_k6_n10():
+    arch = k6_n10_arch()
+    grid = DeviceGrid(4, 4, arch.io_capacity)
+    rr = build_rr_graph(arch, grid, chan_width=20)
+    check_rr_graph(rr)
+    assert rr.chan_width == 20
