@@ -1,0 +1,14 @@
+"""TPU-native negotiated-congestion router.
+
+Layer map (reference equivalents):
+  device_graph  — ELL rr-graph upload (new_rr_graph.h mirror, init.cxx)
+  search        — batched Bellman-Ford relaxation + traceback (dijkstra.h,
+                  delta_stepping.h, route_tree.c)
+  router        — PathFinder outer loop / rip-up-reroute driver
+                  (route_timing.c:85, partitioning_multi_sink…cxx:5937)
+  check         — legality oracle (check_route.c)
+"""
+
+from .check import RouteError, check_route
+from .device_graph import DeviceRRGraph, to_device
+from .router import RouteResult, Router, RouterOpts, RouteStats

@@ -1,0 +1,280 @@
+"""Negotiated-congestion (PathFinder) routing driver.
+
+TPU-native replacement for the reference's whole router family
+(vpr/SRC/route/route_timing.c:85 try_timing_driven_route serial baseline and
+the parallel_route/ drivers, flagship
+partitioning_multi_sink_delta_stepping_route.cxx:5937-6330): the PathFinder
+outer loop runs on the host, but every net in a *batch* is ripped up and
+re-routed by one fixed-shape jitted device program (search.route_net_batch)
+against a congestion snapshot, then the batch's occupancy is committed at
+once.
+
+Where the reference serialises congestion access (coloring schedules,
+per-node spin locks, det_mutex logical clocks), the TPU design:
+  - costs every net against the occupancy of everyone *but itself*
+    (serial rip-up-one-net semantics, so batch peers' previous paths are
+    visible),
+  - schedules nets that fought over a node last iteration into different
+    commit groups (the reference's coloring schedule,
+    custom_vertex_coloring …cxx:3323),
+  - breaks exact cost ties between bus-twin nets with a deterministic
+    per-net jitter,
+and relies on PathFinder present/history costs for the rest.  Determinism
+is free: batch order and all reductions are fixed.  The batch size is the
+analogue of --num_threads.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..rr.graph import RRGraph
+from ..rr.terminals import NetTerminals
+from .device_graph import DeviceRRGraph, to_device
+from .search import (congestion_cost, occupancy_delta, route_net_batch,
+                     usage_from_paths)
+
+
+@dataclass
+class RouterOpts:
+    """Knobs mirroring s_router_opts (vpr/SRC/base/vpr_types.h:708-770) with
+    SetupVPR.c defaults: initial_pres_fac=0.5:401, pres_fac_mult=1.3:363,
+    acc_fac=1, max_router_iterations=50:355, bb_factor=3:337."""
+    max_router_iterations: int = 50
+    initial_pres_fac: float = 0.5
+    pres_fac_mult: float = 1.3
+    acc_fac: float = 1.0
+    bb_factor: int = 3
+    batch_size: int = 64          # nets routed concurrently (≈ num_threads)
+    sink_group: int = 1           # sinks per wave; 1 = exact VPR incremental
+                                  # (>1 ≈ MultiSinkParallelRouter:975)
+    max_pres_fac: float = 1000.0
+    # after this iteration, rip up & reroute only illegal nets
+    # (reference phase-two style refinement, …cxx:6238-6267)
+    incremental_after: int = 1
+
+
+@dataclass
+class RouteStats:
+    """Per-iteration stats (iter_stats.txt schema,
+    partitioning_multi_sink…cxx:5925-5931)."""
+    iteration: int
+    overused_nodes: int
+    overuse_total: int
+    rerouted_nets: int
+    route_time_s: float
+
+
+@dataclass
+class RouteResult:
+    success: bool
+    iterations: int
+    paths: np.ndarray            # [R, Smax, Lmax] int32, sentinel N = pad
+    sink_delay: np.ndarray       # [R, Smax] f32
+    occ: np.ndarray              # [N] int32 final occupancy
+    wirelength: int
+    stats: List[RouteStats] = field(default_factory=list)
+    # search effort counter (perf_t analogue, route.h:12-20)
+    total_net_routes: int = 0
+
+
+def _color_schedule(idx: np.ndarray, paths: np.ndarray, occ: np.ndarray,
+                    cap: np.ndarray, N: int):
+    """Greedy-color the net conflict graph (nets sharing an overused node);
+    each color class becomes its own commit group, serialising exactly the
+    nets that are fighting while keeping independent nets concurrent."""
+    over_nodes = np.where(occ > cap)[0]
+    if len(over_nodes) == 0:
+        return [idx]
+    over_set = np.zeros(N + 1, dtype=bool)
+    over_set[over_nodes] = True
+    users = {}
+    net_over = {}
+    for r in idx:
+        p = paths[r].ravel()
+        p = p[p < N]
+        ov = np.unique(p[over_set[p]])
+        net_over[r] = ov
+        for v in ov:
+            users.setdefault(int(v), []).append(r)
+    color = {}
+    for r in idx:
+        taken = set()
+        for v in net_over[r]:
+            for peer in users[int(v)]:
+                if peer != r and peer in color:
+                    taken.add(color[peer])
+        c = 0
+        while c in taken:
+            c += 1
+        color[r] = c
+    ncolors = max(color.values()) + 1
+    return [np.array([r for r in idx if color[r] == c], dtype=idx.dtype)
+            for c in range(ncolors)]
+
+
+def _pad_to(a: np.ndarray, B: int, fill) -> np.ndarray:
+    n = a.shape[0]
+    if n == B:
+        return a
+    pad = np.full((B - n,) + a.shape[1:], fill, dtype=a.dtype)
+    return np.concatenate([a, pad], axis=0)
+
+
+def _pow2_at_least(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+class Router:
+    """Holds device state across a route() call; reusable across calls
+    (e.g. the placer's delay-lookup routing, timing_place_lookup.c:981)."""
+
+    def __init__(self, rr: RRGraph, opts: Optional[RouterOpts] = None):
+        self.rr = rr
+        self.opts = opts or RouterOpts()
+        self.dev: DeviceRRGraph = to_device(rr)
+        nx, ny = rr.grid.nx, rr.grid.ny
+        # path-length / BF-step bound: a bb-confined path can wind, give slack
+        self.max_len = 4 * (nx + ny) + 64
+
+    def route(self, term: NetTerminals,
+              crit: Optional[np.ndarray] = None,
+              timing_cb: Optional[Callable[["RouteResult"], np.ndarray]]
+              = None) -> RouteResult:
+        """Route all nets.  crit [R, Smax] per-sink criticalities (0 =>
+        pure congestion-driven).  timing_cb, if given, is called after each
+        iteration with the current result and must return updated per-sink
+        criticalities (the analyze_timing / update_sink_criticalities hook,
+        parallel_route/router.cxx:28,42)."""
+        opts = self.opts
+        rr, dev = self.rr, self.dev
+        R, Smax = term.sinks.shape
+        N = rr.num_nodes
+        B = min(opts.batch_size, max(1, R))
+
+        if crit is None:
+            crit = np.zeros((R, Smax), dtype=np.float32)
+
+        occ = jnp.zeros(N, dtype=jnp.int32)
+        acc = jnp.ones(N, dtype=jnp.float32)
+        cap_np = np.asarray(rr.capacity, dtype=np.int64)
+        nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
+
+        paths = np.full((R, Smax, self.max_len), N, dtype=np.int32)
+        sink_delay = np.full((R, Smax), np.inf, dtype=np.float32)
+        routed_once = np.zeros(R, dtype=bool)
+        all_reached = np.zeros(R, dtype=bool)
+
+        bb = np.stack([term.bb_xmin, term.bb_xmax,
+                       term.bb_ymin, term.bb_ymax], axis=1).astype(np.int32)
+        full_bb = np.array([0, rr.grid.nx + 1, 0, rr.grid.ny + 1],
+                           dtype=np.int32)
+        sinks_np = term.sinks.astype(np.int32)
+        source_np = term.source.astype(np.int32)
+        nsinks_np = term.num_sinks.astype(np.int64)
+
+        pres_fac = opts.initial_pres_fac
+        result = RouteResult(False, 0, paths, sink_delay, None, 0)
+
+        for it in range(1, opts.max_router_iterations + 1):
+            t0 = time.time()
+            occ_np = np.asarray(occ)
+            if it <= opts.incremental_after:
+                reroute = np.ones(R, dtype=bool)
+            else:
+                over_mask = occ_np > cap_np
+                reroute = np.zeros(R, dtype=bool)
+                for r in range(R):
+                    p = paths[r].ravel()
+                    p = p[p < N]
+                    if p.size and over_mask[p].any():
+                        reroute[r] = True
+                reroute |= ~routed_once
+                reroute |= ~all_reached
+            idx = np.where(reroute)[0]
+
+            if it > 1 and len(idx) > 1:
+                groups = _color_schedule(idx, paths, occ_np, cap_np, N)
+            else:
+                groups = [idx]
+            # fanout-homogeneous batches: fewer wasted waves
+            batches = []
+            for g in groups:
+                g = g[np.argsort(-nsinks_np[g], kind="stable")]
+                batches.extend(g[lo:lo + B] for lo in range(0, len(g), B))
+
+            for sel in batches:
+                nsel = len(sel)
+                b_valid = np.zeros(B, dtype=bool)
+                b_valid[:nsel] = True
+                b_valid_j = jnp.asarray(b_valid)
+                b_paths = _pad_to(paths[sel], B, N)
+
+                # rip up this batch's previous usage from the running occ,
+                # but cost each net against the occupancy of *everyone
+                # else* (including batch peers' previous paths) — the
+                # serial rip-up-one-net-at-a-time view, route_timing.c:399
+                old_usage = usage_from_paths(jnp.asarray(b_paths), nodes_p1)
+                occ_view = occ[None, :] - old_usage.astype(jnp.int32)
+                occ = occ - occupancy_delta(old_usage, b_valid_j)
+
+                cong = congestion_cost(dev, occ_view, acc,
+                                       jnp.float32(pres_fac))
+                max_ns = int(nsinks_np[sel].max())
+                waves = _pow2_at_least(
+                    max(1, math.ceil(max_ns / opts.sink_group)))
+                p, reached, delay, usage = route_net_batch(
+                    dev, cong,
+                    jnp.asarray(_pad_to(source_np[sel], B, 0)),
+                    jnp.asarray(_pad_to(sinks_np[sel], B, -1)),
+                    jnp.asarray(_pad_to(bb[sel], B, 0)),
+                    jnp.asarray(_pad_to(crit[sel], B, 0.0)),
+                    jnp.asarray(_pad_to(sel.astype(np.int32), B, 0)),
+                    self.max_len, self.max_len, waves, opts.sink_group)
+                occ = occ + occupancy_delta(usage, b_valid_j)
+
+                paths[sel] = np.asarray(p[:nsel])
+                sink_delay[sel] = np.asarray(delay[:nsel])
+                routed_once[sel] = True
+                reached_np = np.asarray(reached[:nsel])
+                smask = np.arange(Smax)[None, :] < nsinks_np[sel][:, None]
+                ok = (reached_np | ~smask).all(axis=1)
+                all_reached[sel] = ok
+                # a sink was unreachable inside its bounding box: retry
+                # with the full device (place_and_route.c bb relaxation)
+                bb[sel[~ok]] = full_bb
+                result.total_net_routes += nsel
+
+            occ_np = np.asarray(occ)
+            over = np.maximum(0, occ_np - cap_np)
+            n_over = int((over > 0).sum())
+            result.stats.append(RouteStats(
+                it, n_over, int(over.sum()), len(idx), time.time() - t0))
+
+            if n_over == 0 and all_reached.all():
+                result.success = True
+                result.iterations = it
+                break
+
+            # pathfinder history/present update (congestion.h:177-193)
+            acc = acc + opts.acc_fac * jnp.asarray(over, dtype=jnp.float32)
+            pres_fac = min(opts.max_pres_fac, pres_fac * opts.pres_fac_mult)
+
+            if timing_cb is not None:
+                result.occ = occ_np
+                crit = np.asarray(timing_cb(result), dtype=np.float32)
+        else:
+            result.iterations = opts.max_router_iterations
+
+        result.occ = np.asarray(occ)
+        union = np.zeros(N + 1, dtype=bool)
+        union[paths.ravel()] = True
+        is_wire = np.asarray(self.dev.is_wire)
+        result.wirelength = int(union[:N][is_wire].sum())
+        return result

@@ -1,0 +1,273 @@
+"""Batched shortest-path search on the TPU.
+
+Replaces the reference's per-sink sequential A*/Dijkstra heap expansion
+(vpr/SRC/parallel_route/dijkstra.h:15, SinkRouter
+partitioning_multi_sink_delta_stepping_route.cxx:360-815) with a pull-based
+Bellman-Ford relaxation vmapped over a *batch of nets*:
+
+    dist[b, v] <- min(dist[b, v],
+                      min_d dist[b, ell_src[v, d]] + w(b, v, d))
+
+with  w = crit_b * edge_delay + (1 - crit_b) * cong_cost[b, v]
+(the PathFinder cost of vpr/SRC/route/route_timing.c:603
+timing_driven_expand_neighbours: crit * Tdel + (1-crit) * rr_cong_cost).
+
+Multi-sink nets are routed *incrementally*, VPR-style: sinks are picked in
+waves (most critical / nearest first), each wave's relaxation is seeded with
+distance 0 on every node of the tree routed so far, so later sinks reuse the
+existing tree (route_tree_timing.c semantics; the reference's sink-parallel
+variant MultiSinkParallelRouter:975 maps to group>1 — several sinks per wave
+share one relaxation).  Without this seeding, a net's sinks take independent
+shortest paths and e.g. two nets driven by a 2-pin output class can each
+grab both OPINs and livelock on overuse.
+
+Search is confined to the net bounding box by masking (route.h:70-165
+per-net boxes, SinkRouter::expand_node:466 pruning).  Everything is
+fixed-shape and jit-compiled; inner loops are lax.while_loop / lax.scan.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .device_graph import DeviceRRGraph
+
+INF = jnp.inf
+
+# relative magnitude of the symmetry-breaking congestion jitter: nets with
+# identical terminals (bus nets) routed against the same frozen congestion
+# snapshot would otherwise pick identical paths every iteration and livelock
+# — the reference never hits this because it serialises congestion commits
+# (coloring schedule / det_mutex); a stable multiplicative per-(net, node)
+# perturbation restores negotiation while keeping runs bit-reproducible.
+JITTER_EPS = 0.02
+
+
+def congestion_cost(dev: DeviceRRGraph, occ: jnp.ndarray, acc: jnp.ndarray,
+                    pres_fac: jnp.ndarray) -> jnp.ndarray:
+    """Per-node congestion cost  base * pres * acc.
+
+    occ may be [N] (global) or [B, N] (per-net views — each net sees the
+    occupancy of *everyone but itself*, which is how the serial reference
+    negotiates: when net i reroutes, occ still contains all other nets'
+    paths, route_timing.c rip-up-one-at-a-time semantics).  pres is the
+    *speculative* present cost of adding one more user
+    (vpr/SRC/route/route_common.c get_rr_cong_cost +
+    parallel_route/congestion.h:177-193 update_costs semantics).
+    """
+    over = occ + 1 - dev.capacity
+    pres = jnp.where(over > 0, 1.0 + over.astype(jnp.float32) * pres_fac, 1.0)
+    return dev.cong_base * pres * acc
+
+
+def _relax(dev: DeviceRRGraph, cong_c: jnp.ndarray, crit_c: jnp.ndarray,
+           inside: jnp.ndarray, seed: jnp.ndarray, seed_tdel: jnp.ndarray,
+           max_steps: int):
+    """One seeded Bellman-Ford solve for a batch.
+
+    cong_c [B, N] congestion term (already scaled by (1-crit) and jitter);
+    crit_c [B, 1] delay-term weight; inside [B, N] bb mask; seed [B, N] tree
+    nodes (dist 0); seed_tdel [B, N] true delay-from-source at tree nodes.
+    Returns (dist, prev, tdel): tdel[b, v] is the accumulated *pure delay*
+    from the net source along the chosen min-cost path (rides along with the
+    cost minimisation; this is what STA consumes, t_net_timing
+    vpr_types.h:1134).
+    """
+    B, N = cong_c.shape
+    D = dev.max_in_degree
+
+    dist0 = jnp.where(seed, 0.0, INF)
+    tdel0 = jnp.where(seed, seed_tdel, 0.0)
+    prev0 = jnp.full((B, N), -1, jnp.int32)
+
+    def step(state):
+        dist, prev, tdel, _, it = state
+
+        def slot(d, carry):
+            best, bsrc, btdel = carry
+            s = dev.ell_src[:, d]                                # [N]
+            w = dev.ell_delay[:, d]
+            valid = dev.ell_valid[:, d]
+            cand = dist[:, s] + crit_c * w[None, :] + cong_c     # [B, N]
+            cand = jnp.where(valid[None, :], cand, INF)
+            better = cand < best
+            best = jnp.where(better, cand, best)
+            bsrc = jnp.where(better, s[None, :], bsrc)
+            btdel = jnp.where(better, tdel[:, s] + w[None, :], btdel)
+            return best, bsrc, btdel
+
+        best, bsrc, btdel = lax.fori_loop(
+            0, D, slot,
+            (jnp.full((B, N), INF, jnp.float32),
+             jnp.full((B, N), -1, jnp.int32),
+             jnp.zeros((B, N), jnp.float32)))
+
+        cand = jnp.where(inside, best, INF)
+        improved = cand < dist
+        dist2 = jnp.where(improved, cand, dist)
+        prev2 = jnp.where(improved, bsrc, prev)
+        tdel2 = jnp.where(improved, btdel, tdel)
+        return dist2, prev2, tdel2, jnp.any(improved), it + 1
+
+    def cond(state):
+        return state[3] & (state[4] < max_steps)
+
+    dist, prev, tdel, _, _ = lax.while_loop(
+        cond, step, (dist0, prev0, tdel0, jnp.bool_(True), jnp.int32(0)))
+    return dist, prev, tdel
+
+
+def _traceback(prev: jnp.ndarray, seed: jnp.ndarray, sink: jnp.ndarray,
+               max_len: int):
+    """Walk prev pointers from sink until a seed (tree) node; [B, G] sinks.
+
+    Returns (path [B, G, L] node ids, sentinel N = pad; reached [B, G]).
+    The joining tree node is included in the path (for wave 1 that is the
+    SOURCE, so a sink's stored path always ends on the existing tree).
+    """
+    B, N = prev.shape
+
+    def one(prev_b, seed_b, sk):
+        valid0 = sk >= 0
+
+        def body(carry, _):
+            node, done = carry
+            nc = jnp.clip(node, 0)
+            at_tree = seed_b[nc]
+            dead = node < 0
+            emit = jnp.where(done | dead, N, node)
+            nxt = jnp.where(done | at_tree | dead, node, prev_b[nc])
+            return (nxt, done | at_tree | dead), emit
+
+        (last, _), path = lax.scan(
+            body, (jnp.where(valid0, sk, -1), ~valid0), None, length=max_len)
+        reached = valid0 & (last >= 0) & seed_b[jnp.clip(last, 0)]
+        path = jnp.where(reached, path, N)
+        return path, reached
+
+    return jax.vmap(jax.vmap(one, in_axes=(None, None, 0)),
+                    in_axes=(0, 0, 0))(prev, seed, sink)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_steps", "max_len", "num_waves",
+                                    "group"))
+def route_net_batch(dev: DeviceRRGraph, cong: jnp.ndarray,
+                    source: jnp.ndarray, sinks: jnp.ndarray,
+                    bb: jnp.ndarray, crit: jnp.ndarray,
+                    net_key: jnp.ndarray,
+                    max_steps: int, max_len: int, num_waves: int,
+                    group: int):
+    """Route a batch of B nets completely (all sinks, incremental tree).
+
+    cong [B, N] per-net congestion cost; source [B]; sinks [B, S] (-1 pad);
+    bb [B, 4]; crit [B, S] per-sink criticalities; net_key [B] stable ids
+    for the symmetry-breaking jitter.
+
+    Returns (paths [B, S, L] sentinel-N-padded sink->tree segments,
+    reached [B, S], sink_delay [B, S], usage [B, N] tree-node masks).
+    """
+    B, S = sinks.shape
+    N = dev.num_nodes
+
+    inside = ((dev.xhigh[None, :] >= bb[:, 0, None])
+              & (dev.xlow[None, :] <= bb[:, 1, None])
+              & (dev.yhigh[None, :] >= bb[:, 2, None])
+              & (dev.ylow[None, :] <= bb[:, 3, None]))           # [B, N]
+
+    # deterministic per-(net, node) hash in [0, 1)
+    h = (net_key[:, None] * jnp.int32(2654435761 & 0x7FFFFFFF)
+         + jnp.arange(N, dtype=jnp.int32)[None, :] * jnp.int32(40503))
+    jitter = 1.0 + JITTER_EPS * ((h & 0xFFFF).astype(jnp.float32) / 65536.0)
+
+    arangeB = jnp.arange(B)
+    # seed with one slot of slack so sentinel scatters drop cleanly
+    seed = jnp.zeros((B, N + 1), bool).at[arangeB, source].set(True)
+    tdel_tree = jnp.zeros((B, N), jnp.float32)
+    remaining = sinks >= 0                                        # [B, S]
+    paths = jnp.full((B, S, max_len), N, jnp.int32)
+    delay = jnp.full((B, S), INF, jnp.float32)
+    reached_all = jnp.zeros((B, S), bool)
+
+    for _ in range(num_waves):
+        # wave criticality: strongest remaining sink drives the delay weight
+        crit_w = jnp.max(jnp.where(remaining, crit, 0.0), axis=1)  # [B]
+        cong_c = (1.0 - crit_w)[:, None] * cong * jitter
+        dist, prev, tdel = _relax(dev, cong_c, crit_w[:, None], inside,
+                                  seed[:, :N], tdel_tree, max_steps)
+
+        # pick up to `group` sinks: most critical first, nearest to the
+        # current tree among equals (route_timing.c sorts sinks by
+        # criticality; nearest-first minimises wirelength when crit == 0)
+        sink_c = jnp.clip(sinks, 0)
+        sd = dist[arangeB[:, None], sink_c]                       # [B, S]
+        score = jnp.where(remaining & jnp.isfinite(sd),
+                          sd - crit * 1e3, INF)
+        order = jnp.argsort(score, axis=1)[:, :group]             # [B, G]
+        pick_valid = (jnp.take_along_axis(remaining, order, axis=1)
+                      & jnp.isfinite(jnp.take_along_axis(score, order,
+                                                         axis=1)))
+        pick_sink = jnp.where(pick_valid,
+                              jnp.take_along_axis(sinks, order, axis=1), -1)
+
+        seg, seg_reached = _traceback(prev, seed[:, :N], pick_sink, max_len)
+        ok = pick_valid & seg_reached                             # [B, G]
+
+        # store segments and delays at the picked sink slots
+        old = jnp.take_along_axis(paths, order[:, :, None], axis=1)
+        paths = _scatter_rows(paths, order,
+                              jnp.where(ok[:, :, None], seg, old))
+        d_new = tdel[arangeB[:, None], jnp.clip(pick_sink, 0)]
+        old_d = jnp.take_along_axis(delay, order, axis=1)
+        delay = _scatter_vals(delay, order, jnp.where(ok, d_new, old_d))
+        old_r = jnp.take_along_axis(reached_all, order, axis=1)
+        reached_all = _scatter_vals(reached_all, order, ok | old_r)
+        old_rem = jnp.take_along_axis(remaining, order, axis=1)
+        remaining = _scatter_vals(remaining, order, old_rem & ~ok)
+
+        # grow the tree: segment nodes become seeds with their true delay
+        flat = jnp.where(ok[:, :, None], seg, N).reshape(B, -1)
+        newly = jnp.zeros((B, N + 1), bool).at[
+            arangeB[:, None], flat].set(True)
+        tdel_tree = jnp.where(newly[:, :N], tdel, tdel_tree)
+        seed = seed | newly
+
+    return paths, reached_all, delay, seed[:, :N]
+
+
+def _scatter_rows(arr, idx, vals):
+    """arr [B, S, L], idx [B, G], vals [B, G, L] -> arr with rows replaced."""
+    B = arr.shape[0]
+    return arr.at[jnp.arange(B)[:, None], idx].set(vals)
+
+
+def _scatter_vals(arr, idx, vals):
+    """arr [B, S], idx [B, G], vals [B, G]."""
+    B = arr.shape[0]
+    return arr.at[jnp.arange(B)[:, None], idx].set(vals)
+
+
+@jax.jit
+def usage_from_paths(path: jnp.ndarray, num_nodes_p1: jnp.ndarray):
+    """Per-net deduplicated node usage mask.
+
+    path [B, S, L] with sentinel N; returns bool [B, N].  A node used by
+    several sink segments of the same net counts once (occupancy is per
+    net, route_tree semantics of parallel_route/route_tree.c).
+    num_nodes_p1: zeros [N+1] template (keeps N out of the traced shapes).
+    """
+    B = path.shape[0]
+    flat = path.reshape(B, -1)
+    u = jnp.zeros((B, num_nodes_p1.shape[0]), bool)
+    u = u.at[jnp.arange(B)[:, None], flat].set(True)
+    return u[:, :-1]
+
+
+@jax.jit
+def occupancy_delta(usage: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Sum per-net usage masks into an occupancy delta [N] (int32)."""
+    return jnp.sum(usage & valid[:, None], axis=0, dtype=jnp.int32)

@@ -405,11 +405,13 @@ def check_rr_graph(rr: RRGraph, reachability: bool = True) -> None:
     b = b[np.lexsort((b[:, 1], b[:, 0]))]
     assert np.array_equal(a, b), "in/out CSR mismatch"
 
-    # every OPIN drives a wire; every non-clock IPIN is driven by a wire
+    # every OPIN drives a wire; every IPIN is driven by a wire
     out_deg = np.diff(rr.out_row_ptr)
     in_deg = np.diff(rr.in_row_ptr)
     opins = rr.node_type == OPIN
     assert np.all(out_deg[opins] >= 1), "dead OPIN"
+    ipins = rr.node_type == IPIN
+    assert np.all(in_deg[ipins] >= 1), "dead IPIN (no driving wire)"
     assert np.all(out_deg[rr.node_type == SINK] == 0)
     assert np.all(in_deg[rr.node_type == SOURCE] == 0)
 

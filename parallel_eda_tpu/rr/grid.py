@@ -14,6 +14,7 @@ y in [0, ny]); CHANY(x, y) is the vertical channel right of tile column x
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import List, Tuple
 
@@ -70,13 +71,11 @@ def size_grid(num_clb: int, num_io: int, arch: Arch,
     if nx and ny:
         g = DeviceGrid(nx, ny, arch.io_capacity)
     else:
-        n = 1
-        while True:
-            g = DeviceGrid(n, n, arch.io_capacity)
-            if (n * n >= num_clb
-                    and len(g.io_sites()) * arch.io_capacity >= num_io):
-                break
-            n += 1
+        # io sites on an n x n grid: 4n, each holding io_capacity blocks
+        n = max(1,
+                math.ceil(math.sqrt(num_clb)),
+                math.ceil(num_io / (4 * max(1, arch.io_capacity))))
+        g = DeviceGrid(n, n, arch.io_capacity)
     if g.nx * g.ny < num_clb:
         raise ValueError(f"grid {g.nx}x{g.ny} too small for {num_clb} CLBs")
     if len(g.io_sites()) * g.io_capacity < num_io:

@@ -1,0 +1,96 @@
+"""Router tests: legality oracle (check_route.c semantics), congestion
+negotiation, determinism-as-oracle (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import minimal_arch, k6_n10_arch
+from parallel_eda_tpu.netlist.generate import generate_circuit
+from parallel_eda_tpu.pack.packer import pack_netlist
+from parallel_eda_tpu.place.initial import initial_placement
+from parallel_eda_tpu.rr.grid import DeviceGrid, size_grid
+from parallel_eda_tpu.rr.graph import build_rr_graph, check_rr_graph
+from parallel_eda_tpu.rr.terminals import net_terminals
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
+
+
+def _flow(num_luts=30, chan_width=12, seed=1, arch=None, bb_factor=3):
+    arch = arch or minimal_arch(chan_width=chan_width)
+    nl = generate_circuit(num_luts=num_luts, num_inputs=4, num_outputs=4,
+                          K=arch.K, seed=seed, ff_ratio=0.3)
+    pnl = pack_netlist(nl, arch)
+    n_clb = sum(1 for b in pnl.blocks if b.type_name != "io")
+    n_io = sum(1 for b in pnl.blocks if b.type_name == "io")
+    grid = size_grid(n_clb, n_io, arch)
+    pos = initial_placement(pnl, grid, seed=0)
+    rr = build_rr_graph(arch, grid, chan_width=chan_width)
+    term = net_terminals(pnl, rr, pos, bb_factor=bb_factor)
+    return arch, pnl, grid, pos, rr, term
+
+
+def test_route_small_legal():
+    _, _, _, _, rr, term = _flow(num_luts=30, chan_width=12)
+    r = Router(rr, RouterOpts(batch_size=32))
+    res = r.route(term)
+    assert res.success, f"did not converge: {res.stats[-1]}"
+    stats = check_route(rr, term, res.paths, occ=res.occ)
+    assert stats["wirelength"] == res.wirelength
+    # every sink got a finite delay
+    for i in range(term.num_nets):
+        ns = int(term.num_sinks[i])
+        assert np.all(np.isfinite(res.sink_delay[i, :ns]))
+
+
+def test_route_congestion_negotiation():
+    # narrow channels force overuse in iteration 1 and negotiation after
+    _, _, _, _, rr, term = _flow(num_luts=40, chan_width=6, seed=3)
+    r = Router(rr, RouterOpts(batch_size=64))
+    res = r.route(term)
+    assert res.success, f"did not converge in {res.iterations} iters"
+    check_route(rr, term, res.paths, occ=res.occ)
+
+
+def test_route_deterministic():
+    _, _, _, _, rr, term = _flow(num_luts=25, chan_width=10, seed=7)
+    r1 = Router(rr, RouterOpts(batch_size=16))
+    r2 = Router(rr, RouterOpts(batch_size=16))
+    a = r1.route(term)
+    b = r2.route(term)
+    assert a.success and b.success
+    assert np.array_equal(a.paths, b.paths)
+    assert np.array_equal(a.occ, b.occ)
+
+
+def test_route_batch_size_invariant_legality():
+    # different batch sizes may give different trees, but all must be legal
+    _, _, _, _, rr, term = _flow(num_luts=25, chan_width=10, seed=5)
+    for bs in (1, 8, 128):
+        res = Router(rr, RouterOpts(batch_size=bs)).route(term)
+        assert res.success, f"batch_size={bs} failed"
+        check_route(rr, term, res.paths, occ=res.occ)
+
+
+def test_route_k6_n10():
+    arch = k6_n10_arch()
+    _, _, _, _, rr, term = _flow(num_luts=40, chan_width=24, seed=2,
+                                 arch=arch)
+    res = Router(rr, RouterOpts(batch_size=64)).route(term)
+    assert res.success
+    check_route(rr, term, res.paths, occ=res.occ)
+
+
+def test_route_timing_criticality_path():
+    # with crit=1 the router minimises pure delay: delays must not exceed
+    # the congestion-driven ones on an uncongested device
+    _, _, _, _, rr, term = _flow(num_luts=15, chan_width=16, seed=9)
+    r = Router(rr, RouterOpts(batch_size=32))
+    res0 = r.route(term)
+    crit = np.full(term.sinks.shape, 0.99, dtype=np.float32)
+    res1 = r.route(term, crit=crit)
+    assert res0.success and res1.success
+    check_route(rr, term, res1.paths)
+    ns_mask = np.arange(term.sinks.shape[1])[None, :] < \
+        term.num_sinks[:, None]
+    d0 = res0.sink_delay[ns_mask]
+    d1 = res1.sink_delay[ns_mask]
+    assert d1.sum() <= d0.sum() * 1.01
