@@ -1,0 +1,87 @@
+"""Benchmark: batched TPU PathFinder routing throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric is nets-routed-per-second over a complete negotiated-congestion
+route (the reference's primary throughput counter — nets routed per
+iteration over route time, iter_stats.txt schema,
+partitioning_multi_sink_delta_stepping_route.cxx:5925-5931).
+
+vs_baseline is the speedup of the batched device router (batch_size=64,
+the analogue of the reference's --num_threads) over the same engine forced
+serial (batch_size=1, one net per device dispatch — the reference's serial
+try_timing_driven_route baseline, route_timing.c:85), measured on identical
+work (iteration 1: every net routed once).
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def build(num_luts=200, chan_width=16, seed=11):
+    from parallel_eda_tpu.flow import synth_flow
+
+    flow = synth_flow(num_luts=num_luts, num_inputs=12, num_outputs=12,
+                      chan_width=chan_width, seed=seed)
+    return flow.rr, flow.term
+
+
+def main():
+    from parallel_eda_tpu.route import Router, RouterOpts
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--luts", type=int, default=200)
+    ap.add_argument("--chan_width", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    rr, term = build(num_luts=args.luts, chan_width=args.chan_width)
+
+    # warmup: a full route populates the compile cache for every wave
+    # variant the negotiation loop can hit
+    Router(rr, RouterOpts(batch_size=args.batch)).route(term)
+
+    # batched: full negotiated route
+    r = Router(rr, RouterOpts(batch_size=args.batch))
+    t0 = time.time()
+    res = r.route(term)
+    dt = time.time() - t0
+    nets_per_sec = res.total_net_routes / dt
+
+    # serial baseline: identical work (one full rip-up-and-route pass of
+    # every net), one net per dispatch
+    rs = Router(rr, RouterOpts(batch_size=1, max_router_iterations=1))
+    rs.route(term)                       # warmup serial shapes
+    t0 = time.time()
+    res_s = rs.route(term)
+    dt_s = time.time() - t0
+    serial_nets_per_sec = res_s.total_net_routes / dt_s
+
+    # re-measure batched on the same 1-iteration work for a fair ratio
+    r1 = Router(rr, RouterOpts(batch_size=args.batch, max_router_iterations=1))
+    t0 = time.time()
+    res_b1 = r1.route(term)
+    dt_b1 = time.time() - t0
+    speedup = (res_b1.total_net_routes / dt_b1) / serial_nets_per_sec
+
+    print(json.dumps({
+        "metric": "nets_routed_per_sec",
+        "value": round(float(nets_per_sec), 2),
+        "unit": "nets/s",
+        "vs_baseline": round(float(speedup), 2),
+        "detail": {
+            "routed": bool(res.success),
+            "iterations": int(res.iterations),
+            "total_net_routes": int(res.total_net_routes),
+            "route_time_s": round(dt, 3),
+            "serial_nets_per_sec": round(float(serial_nets_per_sec), 2),
+            "wirelength": int(res.wirelength),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,9 +118,20 @@ class Arch:
     block_types: List[BlockType] = field(default_factory=list)
     segments: List[SegmentInf] = field(default_factory=list)
     switches: List[SwitchInf] = field(default_factory=list)
-    # fraction of channel tracks each OPIN / IPIN connects to
+    # fraction of channel tracks each OPIN / IPIN connects to; if the arch
+    # XML gave absolute track counts ("abs" fc type), they are kept in
+    # Fc_*_abs and win over the fractions once the real channel width is
+    # known (rr builder), Process_Fc read_xml_arch_file.c semantics
     Fc_out: float = 0.25
     Fc_in: float = 0.15
+    Fc_out_abs: Optional[int] = None
+    Fc_in_abs: Optional[int] = None
+
+    def fc_frac(self, chan_width: int, is_out: bool) -> float:
+        ab = self.Fc_out_abs if is_out else self.Fc_in_abs
+        if ab is not None:
+            return min(1.0, ab / max(1, chan_width))
+        return self.Fc_out if is_out else self.Fc_in
     # IPIN mux delay (switch index used wire->IPIN)
     ipin_switch: int = 0
     # routing channel default width (overridden by --route_chan_width)

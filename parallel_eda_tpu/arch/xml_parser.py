@@ -85,10 +85,10 @@ def read_arch_xml(path: str) -> Arch:
     def _read_fc(scope) -> bool:
         """Apply the first <fc> under ``scope``; VPR7 puts <fc> inside each
         pb_type (default_*_val attrs), VPR8 under <device> (in/out_val).
-        An "abs" fc type means an absolute track count — the device model
-        stores fractions of W, so absolute values are converted when the
-        channel width is known, else flagged (read_xml_arch_file.c
-        Process_Fc semantics)."""
+        An "abs" fc type means an absolute track count — stored separately
+        (Arch.Fc_*_abs) and converted to a fraction by the rr builder once
+        the real channel width is known (read_xml_arch_file.c Process_Fc
+        semantics)."""
         for fc in scope.iter("fc"):
             a = fc.attrib
             if "default_in_val" in a:
@@ -101,13 +101,14 @@ def read_arch_xml(path: str) -> Arch:
                 out_val = _f(a, "out_val", arch.Fc_out)
                 in_type = a.get("in_type", "frac").lower()
                 out_type = a.get("out_type", "frac").lower()
-            W = arch.default_chan_width
             if in_type == "abs":
-                in_val = in_val / max(1, W)
+                arch.Fc_in_abs = int(round(in_val))
+            else:
+                arch.Fc_in = min(1.0, in_val)
             if out_type == "abs":
-                out_val = out_val / max(1, W)
-            arch.Fc_in = min(1.0, in_val)
-            arch.Fc_out = min(1.0, out_val)
+                arch.Fc_out_abs = int(round(out_val))
+            else:
+                arch.Fc_out = min(1.0, out_val)
             return True
         return False
 

@@ -1,0 +1,123 @@
+"""Flow orchestration: the vpr_api / place_and_route equivalent.
+
+Mirrors the reference's flow driver (vpr/SRC/base/vpr_api.c vpr_init /
+vpr_pack / vpr_place_and_route and base/place_and_route.c:51
+place_and_route_new): front end -> pack -> place -> route -> verify, with
+each stage's artifacts exposed so callers (CLI, tests, bench, the driver
+entry points) share one pipeline instead of re-deriving it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .arch.builtin import k6_n10_arch, minimal_arch
+from .arch.model import Arch
+from .netlist.generate import generate_circuit
+from .netlist.netlist import LogicalNetlist
+from .netlist.packed import PackedNetlist
+from .pack.packer import pack_netlist
+from .place.initial import initial_placement
+from .place.sa import Placer, PlacerOpts, PlaceStats
+from .route.check import check_route
+from .route.router import RouteResult, Router, RouterOpts
+from .rr.graph import RRGraph, build_rr_graph, check_rr_graph
+from .rr.grid import DeviceGrid, size_grid
+from .rr.terminals import NetTerminals, net_terminals
+from .timing.graph import TimingGraph, build_timing_graph
+from .timing.sta import TimingAnalyzer
+
+
+@dataclass
+class FlowResult:
+    """Everything the flow produced (the analogue of VPR's globals)."""
+    arch: Arch
+    nl: LogicalNetlist
+    pnl: PackedNetlist
+    grid: DeviceGrid
+    pos: np.ndarray
+    rr: RRGraph
+    term: NetTerminals
+    tg: Optional[TimingGraph] = None
+    analyzer: Optional[TimingAnalyzer] = None
+    route: Optional[RouteResult] = None
+    place_stats: Optional[PlaceStats] = None
+    times: dict = field(default_factory=dict)   # stage -> seconds
+
+    @property
+    def crit_path_delay(self) -> float:
+        return self.analyzer.crit_path_delay if self.analyzer else float(
+            "nan")
+
+
+def prepare(nl: LogicalNetlist, arch: Arch, chan_width: int,
+            seed: int = 0, nx: int = 0, ny: int = 0,
+            bb_factor: int = 3) -> FlowResult:
+    """Front end through initial placement + rr-graph (no SA, no route)."""
+    t0 = time.time()
+    pnl = pack_netlist(nl, arch)
+    t_pack = time.time() - t0
+    n_clb = sum(1 for i in range(pnl.num_blocks)
+                if not pnl.block_type(i).is_io)
+    n_io = pnl.num_blocks - n_clb
+    grid = size_grid(n_clb, n_io, arch, nx=nx, ny=ny)
+    pos = initial_placement(pnl, grid, seed=seed)
+    t0 = time.time()
+    rr = build_rr_graph(arch, grid, chan_width=chan_width)
+    t_rr = time.time() - t0
+    term = net_terminals(pnl, rr, pos, bb_factor=bb_factor)
+    res = FlowResult(arch=arch, nl=nl, pnl=pnl, grid=grid, pos=pos, rr=rr,
+                     term=term)
+    res.times["pack"] = t_pack
+    res.times["rr_graph"] = t_rr
+    return res
+
+
+def synth_flow(num_luts: int = 100, num_inputs: int = 8,
+               num_outputs: int = 8, chan_width: int = 16, seed: int = 1,
+               ff_ratio: float = 0.3, arch: Optional[Arch] = None,
+               use_k6: bool = False, bb_factor: int = 3) -> FlowResult:
+    """Synthetic-circuit front end (the shared fixture for tests, bench,
+    and the driver entry points)."""
+    arch = arch or (k6_n10_arch() if use_k6 else
+                    minimal_arch(chan_width=chan_width))
+    nl = generate_circuit(num_luts=num_luts, num_inputs=num_inputs,
+                          num_outputs=num_outputs, K=arch.K, seed=seed,
+                          ff_ratio=ff_ratio)
+    return prepare(nl, arch, chan_width, bb_factor=bb_factor)
+
+
+def run_place(flow: FlowResult,
+              opts: Optional[PlacerOpts] = None) -> FlowResult:
+    """SA placement; refreshes net terminals for the new positions."""
+    t0 = time.time()
+    placer = Placer(flow.pnl, flow.grid, opts)
+    flow.pos, flow.place_stats = placer.place(flow.pos)
+    flow.times["place"] = time.time() - t0
+    flow.term = net_terminals(flow.pnl, flow.rr, flow.pos)
+    return flow
+
+
+def run_route(flow: FlowResult, opts: Optional[RouterOpts] = None,
+              timing_driven: bool = True, verify: bool = True
+              ) -> FlowResult:
+    """Route + STA loop + legality oracle (try_route_new semantics,
+    route/route_common.c:298; check_route place_and_route.c:169)."""
+    if timing_driven and flow.tg is None:
+        flow.tg = build_timing_graph(flow.nl, flow.pnl, flow.term)
+        flow.analyzer = TimingAnalyzer(flow.tg)
+    router = Router(flow.rr, opts)
+    t0 = time.time()
+    cb = flow.analyzer.timing_cb if timing_driven else None
+    flow.route = router.route(flow.term, timing_cb=cb)
+    flow.times["route"] = time.time() - t0
+    if timing_driven:
+        flow.analyzer.analyze(flow.route.sink_delay)
+    if verify and flow.route.success:
+        check_route(flow.rr, flow.term, flow.route.paths,
+                    occ=flow.route.occ)
+    return flow

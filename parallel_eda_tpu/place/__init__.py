@@ -1,1 +1,3 @@
 from .initial import initial_placement
+from .sa import (Placer, PlacerOpts, PlaceStats, build_place_problem,
+                 net_bb_cost)

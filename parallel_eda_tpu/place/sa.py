@@ -1,0 +1,418 @@
+"""Simulated-annealing placer with batched parallel moves on the TPU.
+
+TPU-native re-design of the reference's serial annealer
+(vpr/SRC/place/place.c:310 try_place, :246 try_swap hot loop): instead of
+one swap at a time, every device step proposes M moves at once, resolves
+conflicts so the surviving set is provably independent, evaluates all the
+delta costs with one batched gather/reduce, and applies the accepted moves
+with disjoint scatters.  M is the placer's analogue of the router's batch
+size (and of --num_threads in the reference's parallel routers).
+
+Move semantics match try_swap: pick a random block, pick a random legal
+location within ``rlim`` (place.c adaptive range limit), swap with the
+occupant if the target is full.  CLBs move in the interior window; IO
+blocks move along the perimeter ring (the island model of rr.grid).
+
+Conflict resolution replaces the annealer's inherent serialization: each
+move claims its source and destination *sites*; a scatter-argmin keeps the
+lowest-numbered claimant of every site and a move survives only if it owns
+both its claims (the placement analogue of the router's conflict-coloring
+commit groups).  Surviving moves touch pairwise-disjoint blocks and sites,
+so their delta costs are exact except for nets shared between two surviving
+moves (rare; the cost is recomputed exactly from scratch every step, so
+acceptance noise never accumulates — unlike place.c which maintains
+incremental cost and has to re-derive it periodically to bound drift,
+place.c:654-683).
+
+Cost is VPR's linear-congestion wirelength: for each net,
+q(fanout) * (bb_width + bb_height) with the crossing-correction table
+(place.c:197 cross_count); bounding boxes by scatter-min/max over net pins
+(place.c:293 update_bb semantics, recomputed densely).
+
+The adaptive schedule is a faithful port of place.c semantics:
+t *= {0.5, 0.9, 0.95, 0.8} by success rate (update_t place.c:265),
+rlim *= (1 - 0.44 + success_rate) (place.c update_rlim), exit when
+t < 0.005 * cost / num_nets (exit_crit place.c:270), starting T = 20 x the
+std-dev of num_blocks random-move deltas (starting_t place.c:506).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..netlist.packed import PackedNetlist
+from ..rr.grid import DeviceGrid
+
+# VPR's expected-crossing-count correction for the linear-congestion bb cost
+# (place.c cross_count table, nets of 1..50 pins; beyond 50 extrapolated)
+_CROSS_COUNT = [
+    1.0, 1.0, 1.0, 1.0828, 1.1536, 1.2206, 1.2823, 1.3385, 1.3991, 1.4493,
+    1.4974, 1.5455, 1.5937, 1.6418, 1.6899, 1.7304, 1.7709, 1.8114, 1.8519,
+    1.8924, 1.9288, 1.9652, 2.0015, 2.0379, 2.0743, 2.1061, 2.1379, 2.1698,
+    2.2016, 2.2334, 2.2646, 2.2958, 2.3271, 2.3583, 2.3895, 2.4187, 2.4479,
+    2.4772, 2.5064, 2.5356, 2.5610, 2.5864, 2.6117, 2.6371, 2.6625, 2.6887,
+    2.7148, 2.7410, 2.7671, 2.7933,
+]
+
+
+def crossing_factor(num_pins: np.ndarray) -> np.ndarray:
+    """q per net of num_pins terminals: table entry num_pins-1 for 1..50
+    pins, linear extrapolation beyond (place.c get_crossing_count
+    semantics)."""
+    n = np.asarray(num_pins)
+    idx = np.clip(n - 1, 0, 49)
+    q = np.where(n <= 50, np.array(_CROSS_COUNT)[idx],
+                 2.7933 + 0.02616 * (n - 50))
+    return q.astype(np.float32)
+
+
+@struct.dataclass
+class PlaceProblem:
+    """Device-resident static placement data (pytree)."""
+    # per-net pin ELL: blocks of each costed net, padded with -1
+    net_blk: jnp.ndarray       # int32 [NN, P]
+    net_valid: jnp.ndarray     # bool  [NN, P]
+    net_q: jnp.ndarray         # f32   [NN] crossing factor
+    # per-block costed-net ELL (nets this block pins into), -1 padded
+    blk_net: jnp.ndarray       # int32 [NB, F]
+    # block/site model
+    is_io: jnp.ndarray         # bool [NB]
+    ring_xy: jnp.ndarray       # int32 [NRING, 2] perimeter ring tile coords
+    # static geometry (python ints; hashable side data)
+    nx: int = struct.field(pytree_node=False)
+    ny: int = struct.field(pytree_node=False)
+    io_cap: int = struct.field(pytree_node=False)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.blk_net.shape[0]
+
+    @property
+    def num_sites(self) -> int:
+        return self.nx * self.ny + self.ring_xy.shape[0] * self.io_cap
+
+
+@dataclass
+class PlacerOpts:
+    """Annealing knobs (t_annealing_sched / t_placer_opts,
+    vpr/SRC/base/vpr_types.h; defaults per SetupVPR.c / place.c)."""
+    moves_per_step: int = 256      # M: concurrent proposed moves
+    inner_num: float = 1.0         # moves/temp = inner_num * NB^(4/3)
+    exit_t_frac: float = 0.005     # exit when t < frac * cost / num_nets
+    max_temps: int = 500
+    seed: int = 0
+
+
+@dataclass
+class PlaceStats:
+    temps: List[Tuple[float, float, float, float]] = field(
+        default_factory=list)   # (t, cost, success_rate, rlim)
+    initial_cost: float = 0.0
+    final_cost: float = 0.0
+    total_moves: int = 0
+
+
+def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid) -> PlaceProblem:
+    """Extract the ELL tables the device step needs."""
+    NB = pnl.num_blocks
+    costed = [i for i, n in enumerate(pnl.nets)
+              if not n.is_global and n.sinks]
+    NN = max(1, len(costed))
+
+    # per-net block lists (driver + sinks; a block pinned twice counts once)
+    net_blocks = []
+    for ni in costed:
+        n = pnl.nets[ni]
+        blks = [n.driver.block] + [p.block for p in n.sinks]
+        seen, uniq = set(), []
+        for b in blks:
+            if b not in seen:
+                seen.add(b); uniq.append(b)
+        net_blocks.append(uniq)
+    P = max(1, max((len(b) for b in net_blocks), default=1))
+    net_blk = np.full((NN, P), -1, dtype=np.int32)
+    for i, blks in enumerate(net_blocks):
+        net_blk[i, :len(blks)] = blks
+    net_valid = net_blk >= 0
+    npins = np.array([len(b) for b in net_blocks] + [1] * (NN - len(costed)),
+                     dtype=np.int32)[:NN]
+    net_q = crossing_factor(npins)
+
+    # per-block costed-net lists
+    blk_nets = [[] for _ in range(NB)]
+    for i, blks in enumerate(net_blocks):
+        for b in blks:
+            blk_nets[b].append(i)
+    F = max(1, max((len(x) for x in blk_nets), default=1))
+    blk_net = np.full((NB, F), -1, dtype=np.int32)
+    for b, nets in enumerate(blk_nets):
+        blk_net[b, :len(nets)] = nets
+
+    is_io = np.array([pnl.block_type(i).is_io for i in range(NB)], dtype=bool)
+    ring = np.array(grid.io_sites(), dtype=np.int32)
+
+    return PlaceProblem(
+        net_blk=jnp.asarray(net_blk), net_valid=jnp.asarray(net_valid),
+        net_q=jnp.asarray(net_q), blk_net=jnp.asarray(blk_net),
+        is_io=jnp.asarray(is_io), ring_xy=jnp.asarray(ring),
+        nx=grid.nx, ny=grid.ny, io_cap=grid.io_capacity,
+    )
+
+
+# ---------------------------------------------------------------- site maps
+
+def _site_of(pp: PlaceProblem, pos: jnp.ndarray, ring_idx: jnp.ndarray
+             ) -> jnp.ndarray:
+    """Unified site id per block: CLB sites [0, nx*ny), then IO ring sites.
+    ring_idx [NB] is the block's perimeter-ring tile index (-1 for CLBs)."""
+    clb = (pos[:, 1] - 1) * pp.nx + (pos[:, 0] - 1)
+    io = pp.nx * pp.ny + ring_idx * pp.io_cap + pos[:, 2]
+    return jnp.where(pp.is_io, io, clb).astype(jnp.int32)
+
+
+def _ring_index_host(grid: DeviceGrid) -> dict:
+    return {xy: i for i, xy in enumerate(grid.io_sites())}
+
+
+# ---------------------------------------------------------------- cost
+
+def net_bb_cost(pp: PlaceProblem, pos: jnp.ndarray):
+    """Dense bb cost of all costed nets: (cost_total, bb [NN, 4])."""
+    blk = jnp.clip(pp.net_blk, 0)
+    x = jnp.where(pp.net_valid, pos[blk, 0], jnp.int32(10 ** 6))
+    y = jnp.where(pp.net_valid, pos[blk, 1], jnp.int32(10 ** 6))
+    xmin = x.min(axis=1)
+    ymin = y.min(axis=1)
+    x = jnp.where(pp.net_valid, pos[blk, 0], jnp.int32(-(10 ** 6)))
+    y = jnp.where(pp.net_valid, pos[blk, 1], jnp.int32(-(10 ** 6)))
+    xmax = x.max(axis=1)
+    ymax = y.max(axis=1)
+    cost = pp.net_q * ((xmax - xmin + 1) + (ymax - ymin + 1)).astype(
+        jnp.float32)
+    return cost.sum(), jnp.stack([xmin, xmax, ymin, ymax], axis=1)
+
+
+# ---------------------------------------------------------------- one step
+
+def _propose(pp: PlaceProblem, pos, ring_idx, key, rlim, M: int):
+    """Propose M moves: (block [M], new_pos [M,3], new_ring [M])."""
+    NB = pp.num_blocks
+    NRING = pp.ring_xy.shape[0]
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    b = jax.random.randint(k1, (M,), 0, NB)
+    bio = pp.is_io[b]
+    rl = jnp.maximum(1, rlim.astype(jnp.int32))
+
+    # CLB target: uniform window around current pos, clamped to interior
+    d = jax.random.randint(k2, (M, 2), -rl, rl + 1)
+    cx = jnp.clip(pos[b, 0] + d[:, 0], 1, pp.nx)
+    cy = jnp.clip(pos[b, 1] + d[:, 1], 1, pp.ny)
+
+    # IO target: shift along the perimeter ring (ring distance ~ 2x
+    # Manhattan distance for the same rlim), random subtile
+    dr = jax.random.randint(k3, (M,), -2 * rl, 2 * rl + 1)
+    nring = (ring_idx[b] + dr) % NRING
+    nz = jax.random.randint(k4, (M,), 0, pp.io_cap)
+
+    nxny = jnp.where(bio[:, None],
+                     pp.ring_xy[jnp.clip(nring, 0)],
+                     jnp.stack([cx, cy], axis=1))
+    npos = jnp.concatenate(
+        [nxny, jnp.where(bio, nz, 0)[:, None]], axis=1).astype(jnp.int32)
+    nring = jnp.where(bio, nring, -1)
+    return b, npos, nring
+
+
+@functools.partial(jax.jit, static_argnames=("M",))
+def sa_step(pp: PlaceProblem, pos, ring_idx, occ, key, t, rlim, M: int):
+    """One batched SA step: M proposals -> conflict-free subset -> delta
+    evaluation -> Metropolis -> apply.  Returns (pos, ring_idx, occ,
+    n_acc, n_valid, cost_after, delta_sum, delta_sq)."""
+    NB = pp.num_blocks
+    NS = pp.num_sites
+    kp, ka = jax.random.split(key)
+    b, npos, nring = _propose(pp, pos, ring_idx, kp, rlim, M)
+
+    site_all = _site_of(pp, pos, ring_idx)            # [NB]
+    src = site_all[b]                                  # [M]
+    clb_site = (npos[:, 1] - 1) * pp.nx + (npos[:, 0] - 1)
+    io_site = pp.nx * pp.ny + nring * pp.io_cap + npos[:, 2]
+    dst = jnp.where(pp.is_io[b], io_site, clb_site).astype(jnp.int32)
+
+    occ_d = occ[dst]                                   # occupant block or -1
+    self_move = dst == src
+    # claims: lowest move index wins each site
+    claim = jnp.full(NS, M, jnp.int32)
+    claim = claim.at[src].min(jnp.arange(M, dtype=jnp.int32))
+    claim = claim.at[dst].min(jnp.arange(M, dtype=jnp.int32))
+    own = ((claim[src] == jnp.arange(M)) & (claim[dst] == jnp.arange(M))
+           & ~self_move)
+
+    # ---- delta cost of each move (exact under `own` independence) ----
+    o = occ_d                                          # [M] may be -1
+    bnets = pp.blk_net[b]                              # [M, F]
+    onets = jnp.where(o[:, None] >= 0, pp.blk_net[jnp.clip(o, 0)], -1)
+    # drop duplicates: a net in o's list that is also in b's list
+    dup = (onets[:, :, None] == bnets[:, None, :]).any(axis=2)
+    onets = jnp.where(dup, -1, onets)
+    nets = jnp.concatenate([bnets, onets], axis=1)     # [M, 2F]
+    nvalid = nets >= 0
+    netsc = jnp.clip(nets, 0)
+
+    pblk = pp.net_blk[netsc]                           # [M, 2F, P]
+    pvalid = pp.net_valid[netsc] & nvalid[:, :, None]
+    # pin coords with the two blocks transposed
+    px = pos[jnp.clip(pblk, 0), 0]
+    py = pos[jnp.clip(pblk, 0), 1]
+    is_b = pblk == b[:, None, None]
+    is_o = (pblk == o[:, None, None]) & (o[:, None, None] >= 0)
+    px = jnp.where(is_b, npos[:, None, None, 0],
+                   jnp.where(is_o, pos[b, 0][:, None, None], px))
+    py = jnp.where(is_b, npos[:, None, None, 1],
+                   jnp.where(is_o, pos[b, 1][:, None, None], py))
+    big = jnp.int32(10 ** 6)
+    nxmin = jnp.where(pvalid, px, big).min(axis=2)
+    nxmax = jnp.where(pvalid, px, -big).max(axis=2)
+    nymin = jnp.where(pvalid, py, big).min(axis=2)
+    nymax = jnp.where(pvalid, py, -big).max(axis=2)
+    q = pp.net_q[netsc]
+    new_c = q * ((nxmax - nxmin + 1) + (nymax - nymin + 1)).astype(
+        jnp.float32)
+    # old cost of the same nets from current positions
+    oxmin = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 0], big).min(axis=2)
+    oxmax = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 0], -big).max(axis=2)
+    oymin = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 1], big).min(axis=2)
+    oymax = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 1], -big).max(axis=2)
+    old_c = q * ((oxmax - oxmin + 1) + (oymax - oymin + 1)).astype(
+        jnp.float32)
+    delta = jnp.where(nvalid, new_c - old_c, 0.0).sum(axis=1)   # [M]
+
+    # ---- Metropolis ----
+    u = jax.random.uniform(ka, (M,))
+    accept = own & ((delta <= 0)
+                    | (u < jnp.exp(-delta / jnp.maximum(t, 1e-30))))
+
+    # ---- apply (accepted moves touch disjoint blocks & sites) ----
+    bb = jnp.where(accept, b, NB)          # scatter-drop slot NB
+    oo = jnp.where(accept & (o >= 0), o, NB)
+    pos2 = jnp.concatenate([pos, jnp.zeros((1, 3), pos.dtype)], axis=0)
+    pos2 = pos2.at[bb].set(npos)
+    pos2 = pos2.at[oo].set(pos[b])         # occupant takes b's old site
+    ring2 = jnp.concatenate([ring_idx, jnp.zeros((1,), ring_idx.dtype)])
+    ring2 = ring2.at[bb].set(nring)
+    ring2 = ring2.at[oo].set(ring_idx[b])
+    occ2 = jnp.concatenate([occ, jnp.zeros((1,), occ.dtype)])
+    ssrc = jnp.where(accept, src, NS)
+    sdst = jnp.where(accept, dst, NS)
+    occ2 = occ2.at[ssrc].set(o)            # -1 if target was empty
+    occ2 = occ2.at[sdst].set(b)
+
+    pos2, ring2, occ2 = pos2[:NB], ring2[:NB], occ2[:NS]
+    dvalid = jnp.where(own, delta, 0.0)
+    return (pos2, ring2, occ2, accept.sum(), own.sum(),
+            dvalid.sum(), (dvalid * dvalid).sum())
+
+
+@functools.partial(jax.jit, static_argnames=("M", "steps"))
+def sa_temperature(pp: PlaceProblem, pos, ring_idx, occ, key, t, rlim,
+                   M: int, steps: int):
+    """All steps of one temperature as a lax.scan (single dispatch)."""
+    def body(carry, k):
+        pos, ring_idx, occ = carry
+        pos, ring_idx, occ, na, nv, _, _ = sa_step(
+            pp, pos, ring_idx, occ, k, t, rlim, M)
+        return (pos, ring_idx, occ), (na, nv)
+    keys = jax.random.split(key, steps)
+    (pos, ring_idx, occ), (na, nv) = jax.lax.scan(
+        body, (pos, ring_idx, occ), keys)
+    cost, _ = net_bb_cost(pp, pos)
+    return pos, ring_idx, occ, na.sum(), nv.sum(), cost
+
+
+class Placer:
+    """Host driver owning the annealing schedule (place.c:310 try_place)."""
+
+    def __init__(self, pnl: PackedNetlist, grid: DeviceGrid,
+                 opts: Optional[PlacerOpts] = None):
+        self.pnl, self.grid = pnl, grid
+        self.opts = opts or PlacerOpts()
+        self.pp = build_place_problem(pnl, grid)
+        self._ring_of = _ring_index_host(grid)
+
+    def _state_from_pos(self, pos_np: np.ndarray):
+        pp = self.pp
+        NB = self.pnl.num_blocks
+        ring = np.full(NB, -1, dtype=np.int32)
+        for i in range(NB):
+            if bool(np.asarray(pp.is_io)[i]):
+                ring[i] = self._ring_of[(int(pos_np[i, 0]),
+                                         int(pos_np[i, 1]))]
+        pos = jnp.asarray(pos_np, dtype=jnp.int32)
+        ring_j = jnp.asarray(ring)
+        site = np.asarray(_site_of(pp, pos, ring_j))
+        occ = np.full(pp.num_sites, -1, dtype=np.int32)
+        if len(site) != len(set(site.tolist())):
+            raise ValueError("initial placement has site collisions")
+        occ[site] = np.arange(NB)
+        return pos, ring_j, jnp.asarray(occ)
+
+    def place(self, pos0: np.ndarray) -> Tuple[np.ndarray, PlaceStats]:
+        opts, pp = self.opts, self.pp
+        NB = self.pnl.num_blocks
+        NN = pp.net_blk.shape[0]
+        M = min(opts.moves_per_step, max(8, NB))
+        steps = max(1, math.ceil(opts.inner_num * NB ** (4 / 3) / M))
+        pos, ring, occ = self._state_from_pos(pos0)
+        key = jax.random.PRNGKey(opts.seed)
+
+        cost0, _ = net_bb_cost(pp, pos)
+        stats = PlaceStats(initial_cost=float(cost0))
+
+        # starting_t (place.c:506): std-dev of random-move deltas at t=inf
+        key, k = jax.random.split(key)
+        _, _, _, _, nv, dsum, dsq = sa_step(
+            pp, pos, ring, occ, k, jnp.float32(1e30), jnp.float32(
+                max(pp.nx, pp.ny)), M)
+        nv = max(1, int(nv))
+        var = float(dsq) / nv - (float(dsum) / nv) ** 2
+        t = 20.0 * math.sqrt(max(var, 1e-12))
+        rlim = float(max(pp.nx, pp.ny))
+
+        for _ in range(opts.max_temps):
+            key, k = jax.random.split(key)
+            pos, ring, occ, na, nv, cost = sa_temperature(
+                pp, pos, ring, occ, k, jnp.float32(t), jnp.float32(rlim),
+                M, steps)
+            na, nv, cost = int(na), int(nv), float(cost)
+            srat = na / max(1, nv)
+            stats.temps.append((t, cost, srat, rlim))
+            stats.total_moves += nv
+            # update_t / update_rlim (place.c:265)
+            if srat > 0.96:
+                t *= 0.5
+            elif srat > 0.8:
+                t *= 0.9
+            elif srat > 0.15 or rlim > 1.0:
+                t *= 0.95
+            else:
+                t *= 0.8
+            rlim = min(max(pp.nx, pp.ny),
+                       max(1.0, rlim * (1.0 - 0.44 + srat)))
+            if t < opts.exit_t_frac * cost / max(1, NN):
+                break
+
+        # final quench at t=0
+        key, k = jax.random.split(key)
+        pos, ring, occ, _, _, cost = sa_temperature(
+            pp, pos, ring, occ, k, jnp.float32(0.0), jnp.float32(1.0),
+            M, steps)
+        stats.final_cost = float(cost)
+        return np.asarray(pos), stats

@@ -160,6 +160,10 @@ class Router:
 
         if crit is None:
             crit = np.zeros((R, Smax), dtype=np.float32)
+        else:
+            # max_criticality clamp (VPR --max_criticality 0.99): crit of
+            # exactly 1 zeroes the congestion term and kills negotiation
+            crit = np.minimum(np.asarray(crit, dtype=np.float32), 0.99)
 
         occ = jnp.zeros(N, dtype=jnp.int32)
         acc = jnp.ones(N, dtype=jnp.float32)
@@ -188,13 +192,9 @@ class Router:
             if it <= opts.incremental_after:
                 reroute = np.ones(R, dtype=bool)
             else:
-                over_mask = occ_np > cap_np
-                reroute = np.zeros(R, dtype=bool)
-                for r in range(R):
-                    p = paths[r].ravel()
-                    p = p[p < N]
-                    if p.size and over_mask[p].any():
-                        reroute[r] = True
+                # nets using any overused node (sentinel N maps to False)
+                over_p1 = np.append(occ_np > cap_np, False)
+                reroute = over_p1[paths].any(axis=(1, 2))
                 reroute |= ~routed_once
                 reroute |= ~all_reached
             idx = np.where(reroute)[0]
@@ -268,7 +268,8 @@ class Router:
 
             if timing_cb is not None:
                 result.occ = occ_np
-                crit = np.asarray(timing_cb(result), dtype=np.float32)
+                crit = np.minimum(
+                    np.asarray(timing_cb(result), dtype=np.float32), 0.99)
         else:
             result.iterations = opts.max_router_iterations
 

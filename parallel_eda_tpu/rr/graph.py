@@ -274,7 +274,7 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                     cls = bt.pin_classes[k]
                     is_out = cls.direction == PIN_CLASS_DRIVER
                     node = (opin_of if is_out else ipin_of)[(x, y, z, p)]
-                    fc = arch.Fc_out if is_out else arch.Fc_in
+                    fc = arch.fc_frac(W, is_out)
                     pin_ptc = z * bt.num_pins + p
                     for side, (kind, ci, pos) in enumerate(adj):
                         for t in _fc_tracks(pin_ptc, side, W, fc):

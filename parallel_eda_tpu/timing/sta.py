@@ -1,0 +1,129 @@
+"""Static timing analysis on the device.
+
+Replaces the reference's recursive/levelized CPU sweeps
+(vpr/SRC/timing/path_delay.c:1994 do_timing_analysis_new, :3791
+get_critical_path_delay) with max-plus / min-plus ELL relaxations: ``depth``
+dense sweeps over the in-/out-edge tables converge exactly on a DAG of that
+depth, and every sweep is one [T, D] gather + reduce — the same shape the
+router's relaxation uses, so XLA fuses it well.
+
+Per-connection criticality  crit = (1 - slack/Dmax) ** exp  (semantics of
+vpr/SRC/route/route_timing.c:225-268 and timing_place.c:81
+load_criticalities) is scattered back to the router's [R, Smax] layout with
+a max-reduce, closing the analyze_timing -> update_sink_criticalities loop
+(parallel_route/router.cxx:28,42).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .graph import TimingGraph
+
+NEG = -jnp.inf
+
+
+@struct.dataclass
+class DeviceTimingGraph:
+    in_src: jnp.ndarray
+    in_const: jnp.ndarray
+    in_ridx: jnp.ndarray
+    in_valid: jnp.ndarray
+    out_dst: jnp.ndarray
+    out_const: jnp.ndarray
+    out_ridx: jnp.ndarray
+    out_valid: jnp.ndarray
+    arrival0: jnp.ndarray
+    is_endpoint: jnp.ndarray
+
+
+def to_device(tg: TimingGraph) -> DeviceTimingGraph:
+    return DeviceTimingGraph(
+        in_src=jnp.asarray(tg.in_src), in_const=jnp.asarray(tg.in_const),
+        in_ridx=jnp.asarray(tg.in_ridx), in_valid=jnp.asarray(tg.in_valid),
+        out_dst=jnp.asarray(tg.out_dst), out_const=jnp.asarray(tg.out_const),
+        out_ridx=jnp.asarray(tg.out_ridx),
+        out_valid=jnp.asarray(tg.out_valid),
+        arrival0=jnp.asarray(tg.arrival0),
+        is_endpoint=jnp.asarray(tg.is_endpoint),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "crit_exp", "max_crit"))
+def sta_sweep(dev: DeviceTimingGraph, route_delay: jnp.ndarray,
+              depth: int, crit_exp: float = 1.0, max_crit: float = 0.99
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """route_delay: flat [R*Smax + 1] routed per-connection delays with a
+    trailing 0.0 slot so ridx == -1 gathers a zero.  Returns
+    (crit_flat [R*Smax], Dmax scalar, arrival [T])."""
+    rd = jnp.where(jnp.isfinite(route_delay), route_delay, 0.0)
+
+    d_in = dev.in_const + rd[dev.in_ridx]          # [T, D] (-1 -> last slot)
+    d_out = dev.out_const + rd[dev.out_ridx]
+
+    def fwd(_, arr):
+        cand = arr[dev.in_src] + d_in
+        cand = jnp.where(dev.in_valid, cand, NEG)
+        return jnp.maximum(dev.arrival0, cand.max(axis=1))
+
+    arr = jax.lax.fori_loop(0, depth, fwd, dev.arrival0)
+
+    dmax = jnp.max(jnp.where(dev.is_endpoint, arr, NEG))
+    dmax = jnp.where(jnp.isfinite(dmax), dmax, 0.0)
+
+    req0 = jnp.where(dev.is_endpoint, dmax, jnp.inf)
+
+    def bwd(_, req):
+        cand = req[dev.out_dst] - d_out
+        cand = jnp.where(dev.out_valid, cand, jnp.inf)
+        return jnp.minimum(req0, cand.min(axis=1))
+
+    req = jax.lax.fori_loop(0, depth, bwd, req0)
+
+    # per in-edge slack -> criticality, scattered to (net, sink) slots
+    # max_crit clamp (VPR --max_criticality 0.99 default): a criticality of
+    # exactly 1 would zero the congestion term and livelock negotiation
+    slack = req[:, None] - arr[dev.in_src] - d_in          # [T, D]
+    denom = jnp.maximum(dmax, 1e-30)
+    crit = jnp.clip(1.0 - slack / denom, 0.0, max_crit)
+    if crit_exp != 1.0:
+        crit = crit ** crit_exp
+    ok = dev.in_valid & (dev.in_ridx >= 0) & jnp.isfinite(slack)
+    RS = route_delay.shape[0] - 1
+    idx = jnp.where(ok, dev.in_ridx, RS)
+    crit_flat = jnp.zeros(RS + 1, jnp.float32).at[idx.ravel()].max(
+        jnp.where(ok, crit, 0.0).ravel())
+    return crit_flat[:RS], dmax, arr
+
+
+class TimingAnalyzer:
+    """Host wrapper: owns the device graph, exposes the router callback."""
+
+    def __init__(self, tg: TimingGraph, crit_exp: float = 1.0,
+                 max_crit: float = 0.99):
+        self.tg = tg
+        self.dev = to_device(tg)
+        self.crit_exp = crit_exp
+        self.max_crit = max_crit
+        self.crit_path_delay = float("nan")
+
+    def analyze(self, sink_delay: np.ndarray) -> np.ndarray:
+        """sink_delay [R, Smax] from the router -> criticalities [R, Smax];
+        also records crit_path_delay (seconds)."""
+        R, Smax = sink_delay.shape
+        flat = np.append(sink_delay.ravel().astype(np.float32), 0.0)
+        crit, dmax, _ = sta_sweep(self.dev, jnp.asarray(flat),
+                                  self.tg.depth, self.crit_exp,
+                                  self.max_crit)
+        self.crit_path_delay = float(dmax)
+        return np.asarray(crit).reshape(R, Smax)
+
+    def timing_cb(self, result) -> np.ndarray:
+        """Router timing_cb hook (router.py Router.route)."""
+        return self.analyze(result.sink_delay)

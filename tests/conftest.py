@@ -1,16 +1,25 @@
 """Test configuration.
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
-(parallel_eda_tpu.parallel) are exercised without TPU hardware; must be set
-before jax is first imported anywhere in the test process.
+(parallel_eda_tpu.parallel) are exercised without TPU hardware.
+
+The container's sitecustomize registers a tunneled single-chip TPU backend
+("axon") and force-sets jax_platforms to prefer it; a lazily-initialized
+backend dial to a busy/held chip blocks forever.  Tests must never touch
+it: override the config back to cpu BEFORE any jax computation runs (the
+env var alone is not enough — the sitecustomize overwrites it).
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import does not initialize backends)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,80 @@
+"""Placer tests: legality invariants, cost improvement, determinism,
+and schedule behavior (place.c try_place semantics, SURVEY §2.3)."""
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.flow import synth_flow
+from parallel_eda_tpu.place import Placer, PlacerOpts
+
+
+def _problem(num_luts=40, seed=1):
+    f = synth_flow(num_luts=num_luts, num_inputs=4, num_outputs=4,
+                   chan_width=12, seed=seed)
+    return f.arch, f.nl, f.pnl, f.grid, f.pos
+
+
+def _check_legal(pnl, grid, pos):
+    """Placement legality (check_place place.c:253 semantics): every block
+    on a distinct legal site of its type."""
+    seen = set()
+    for bi in range(pnl.num_blocks):
+        x, y, z = (int(v) for v in pos[bi])
+        site = (x, y, z)
+        assert site not in seen, f"two blocks on {site}"
+        seen.add(site)
+        if pnl.block_type(bi).is_io:
+            assert grid.is_io(x, y), f"io block off perimeter: {site}"
+            assert 0 <= z < grid.io_capacity
+        else:
+            assert grid.is_clb(x, y), f"clb block off interior: {site}"
+            assert z == 0
+
+
+def test_place_improves_and_legal():
+    _, _, pnl, grid, pos0 = _problem(num_luts=40)
+    placer = Placer(pnl, grid, PlacerOpts(moves_per_step=64, seed=1))
+    pos, stats = placer.place(pos0)
+    _check_legal(pnl, grid, pos)
+    assert stats.final_cost < stats.initial_cost * 0.9, \
+        f"no improvement: {stats.initial_cost} -> {stats.final_cost}"
+
+
+def test_place_deterministic():
+    _, _, pnl, grid, pos0 = _problem(num_luts=25, seed=5)
+    p1, s1 = Placer(pnl, grid, PlacerOpts(moves_per_step=32,
+                                          seed=7)).place(pos0)
+    p2, s2 = Placer(pnl, grid, PlacerOpts(moves_per_step=32,
+                                          seed=7)).place(pos0)
+    assert np.array_equal(p1, p2)
+    assert s1.final_cost == s2.final_cost
+
+
+def test_place_temperature_schedule():
+    # temperature must be monotonically decreasing and terminate
+    _, _, pnl, grid, pos0 = _problem(num_luts=25, seed=2)
+    placer = Placer(pnl, grid, PlacerOpts(moves_per_step=32, seed=0))
+    _, stats = placer.place(pos0)
+    ts = [t for (t, _, _, _) in stats.temps]
+    assert all(b < a for a, b in zip(ts, ts[1:]))
+    assert len(ts) < placer.opts.max_temps
+
+
+def test_place_cost_matches_oracle():
+    # device bb cost == slow host recomputation
+    from parallel_eda_tpu.place import build_place_problem, net_bb_cost
+    from parallel_eda_tpu.place.sa import crossing_factor
+    import jax.numpy as jnp
+    _, _, pnl, grid, pos0 = _problem(num_luts=30, seed=4)
+    pp = build_place_problem(pnl, grid)
+    cost, _ = net_bb_cost(pp, jnp.asarray(pos0))
+    exp = 0.0
+    for ni, n in enumerate(pnl.nets):
+        if n.is_global or not n.sinks:
+            continue
+        blks = {n.driver.block} | {p.block for p in n.sinks}
+        xs = [pos0[b, 0] for b in blks]
+        ys = [pos0[b, 1] for b in blks]
+        q = float(crossing_factor(np.array([len(blks)]))[0])
+        exp += q * ((max(xs) - min(xs) + 1) + (max(ys) - min(ys) + 1))
+    assert np.isclose(float(cost), exp, rtol=1e-5)

@@ -4,28 +4,16 @@ negotiation, determinism-as-oracle (SURVEY §4)."""
 import numpy as np
 import pytest
 
-from parallel_eda_tpu.arch.builtin import minimal_arch, k6_n10_arch
-from parallel_eda_tpu.netlist.generate import generate_circuit
-from parallel_eda_tpu.pack.packer import pack_netlist
-from parallel_eda_tpu.place.initial import initial_placement
-from parallel_eda_tpu.rr.grid import DeviceGrid, size_grid
-from parallel_eda_tpu.rr.graph import build_rr_graph, check_rr_graph
-from parallel_eda_tpu.rr.terminals import net_terminals
+from parallel_eda_tpu.arch.builtin import k6_n10_arch
+from parallel_eda_tpu.flow import synth_flow
 from parallel_eda_tpu.route import Router, RouterOpts, check_route
 
 
 def _flow(num_luts=30, chan_width=12, seed=1, arch=None, bb_factor=3):
-    arch = arch or minimal_arch(chan_width=chan_width)
-    nl = generate_circuit(num_luts=num_luts, num_inputs=4, num_outputs=4,
-                          K=arch.K, seed=seed, ff_ratio=0.3)
-    pnl = pack_netlist(nl, arch)
-    n_clb = sum(1 for b in pnl.blocks if b.type_name != "io")
-    n_io = sum(1 for b in pnl.blocks if b.type_name == "io")
-    grid = size_grid(n_clb, n_io, arch)
-    pos = initial_placement(pnl, grid, seed=0)
-    rr = build_rr_graph(arch, grid, chan_width=chan_width)
-    term = net_terminals(pnl, rr, pos, bb_factor=bb_factor)
-    return arch, pnl, grid, pos, rr, term
+    f = synth_flow(num_luts=num_luts, num_inputs=4, num_outputs=4,
+                   chan_width=chan_width, seed=seed, arch=arch,
+                   bb_factor=bb_factor)
+    return f.arch, f.pnl, f.grid, f.pos, f.rr, f.term
 
 
 def test_route_small_legal():

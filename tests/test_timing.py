@@ -1,0 +1,96 @@
+"""STA tests: device sweeps vs an independent host longest-path oracle,
+criticality invariants, and the closed router<->STA loop (SURVEY §2.5, §3.5).
+"""
+
+import numpy as np
+
+from parallel_eda_tpu.flow import synth_flow
+from parallel_eda_tpu.route import Router, RouterOpts
+from parallel_eda_tpu.timing import TimingAnalyzer, build_timing_graph
+
+
+def _flow(num_luts=25, chan_width=12, seed=1, ff_ratio=0.3):
+    f = synth_flow(num_luts=num_luts, num_inputs=4, num_outputs=4,
+                   chan_width=chan_width, seed=seed, ff_ratio=ff_ratio)
+    return f.nl, f.pnl, f.rr, f.term
+
+
+def _host_sta(tg, delay_flat):
+    """Independent numpy longest-path oracle over the edge lists."""
+    T = tg.num_tnodes
+    edges = []
+    for v in range(T):
+        for d in range(tg.in_src.shape[1]):
+            if tg.in_valid[v, d]:
+                w = tg.in_const[v, d]
+                if tg.in_ridx[v, d] >= 0:
+                    w += delay_flat[tg.in_ridx[v, d]]
+                edges.append((int(tg.in_src[v, d]), v, float(w)))
+    arr = tg.arrival0.astype(np.float64).copy()
+    for _ in range(tg.depth):
+        for s, v, w in edges:
+            if np.isfinite(arr[s]) and arr[s] + w > arr[v]:
+                arr[v] = arr[s] + w
+    dmax = max((arr[v] for v in range(T) if tg.is_endpoint[v]), default=0.0)
+    return arr, dmax
+
+
+def test_sta_matches_host_oracle():
+    nl, pnl, rr, term = _flow(num_luts=25, seed=2)
+    tg = build_timing_graph(nl, pnl, term)
+    R, Smax = term.sinks.shape
+    rng = np.random.RandomState(0)
+    sink_delay = rng.uniform(1e-10, 2e-9, size=(R, Smax)).astype(np.float32)
+    ta = TimingAnalyzer(tg)
+    crit = ta.analyze(sink_delay)
+    _, dmax = _host_sta(tg, sink_delay.ravel())
+    assert np.isclose(ta.crit_path_delay, dmax, rtol=1e-5)
+    assert crit.shape == (R, Smax)
+    assert np.all(crit >= 0) and np.all(crit <= 1)
+    # something must be critical (max_criticality-clamped at 0.99)
+    assert crit.max() >= 0.989
+
+
+def test_sta_pure_combinational():
+    nl, pnl, rr, term = _flow(num_luts=15, seed=4, ff_ratio=0.0)
+    tg = build_timing_graph(nl, pnl, term)
+    sink_delay = np.full(term.sinks.shape, 1e-9, dtype=np.float32)
+    ta = TimingAnalyzer(tg)
+    ta.analyze(sink_delay)
+    _, dmax = _host_sta(tg, sink_delay.ravel())
+    assert np.isclose(ta.crit_path_delay, dmax, rtol=1e-5)
+    assert ta.crit_path_delay > 0
+
+
+def test_sta_scales_with_route_delay():
+    # doubling every routed delay cannot shrink the critical path
+    nl, pnl, rr, term = _flow(num_luts=20, seed=6)
+    tg = build_timing_graph(nl, pnl, term)
+    ta = TimingAnalyzer(tg)
+    d = np.full(term.sinks.shape, 5e-10, dtype=np.float32)
+    ta.analyze(d)
+    d1 = ta.crit_path_delay
+    ta.analyze(2 * d)
+    d2 = ta.crit_path_delay
+    assert d2 >= d1
+
+
+def test_timing_driven_route_loop():
+    # closed loop: route -> STA -> criticalities -> route; the final
+    # crit-path delay must not regress vs the congestion-only route
+    nl, pnl, rr, term = _flow(num_luts=30, chan_width=12, seed=3)
+    tg = build_timing_graph(nl, pnl, term)
+
+    r = Router(rr, RouterOpts(batch_size=32))
+    res0 = r.route(term)
+    assert res0.success
+    ta0 = TimingAnalyzer(tg)
+    ta0.analyze(res0.sink_delay)
+    base = ta0.crit_path_delay
+
+    ta = TimingAnalyzer(tg)
+    res1 = r.route(term, timing_cb=ta.timing_cb)
+    assert res1.success
+    ta.analyze(res1.sink_delay)
+    assert np.isfinite(ta.crit_path_delay)
+    assert ta.crit_path_delay <= base * 1.05
