@@ -1,0 +1,158 @@
+"""Command-line flow driver.
+
+The vpr-binary equivalent (vpr/SRC/main.c:310 + base/ReadOptions.c CLI):
+
+    python -m parallel_eda_tpu circuit.blif --route_chan_width 24
+    python -m parallel_eda_tpu --luts 200 --binary_search
+    python -m parallel_eda_tpu circuit.blif --place_file out/c.place --route
+
+Flags keep the reference's names where the concept survives on TPU
+(route_chan_width, max_router_iterations, initial_pres_fac, pres_fac_mult,
+acc_fac, bb_factor, astar_fac n/a, max_criticality, inner_num, seed);
+--batch_size replaces --num_threads (OptionTokens.c:60-68) as the
+parallelism knob; placement/routing can each be loaded from checkpoint
+files instead of computed (PLACE_NEVER / route-only resume combinations,
+base/place_and_route.c:83-86).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="parallel_eda_tpu",
+        description="TPU-native FPGA place & route (VPR-7-class flow)")
+    p.add_argument("blif", nargs="?", help="input BLIF netlist "
+                   "(omit to use a synthetic circuit, see --luts)")
+    p.add_argument("--arch", default="k6_n10",
+                   help="arch: k6_n10 | minimal | path to arch XML")
+    # synthetic front end
+    p.add_argument("--luts", type=int, default=100,
+                   help="synthetic circuit size when no BLIF is given")
+    p.add_argument("--seed", type=int, default=1)
+    # flow stage selection / resume files
+    p.add_argument("--no_place", action="store_true",
+                   help="keep the deterministic initial placement")
+    p.add_argument("--route", action="store_true", default=True)
+    p.add_argument("--no_route", dest="route", action="store_false")
+    p.add_argument("--net_file", help="read packed netlist (.net) instead "
+                   "of running the packer (the logical netlist is still "
+                   "needed for timing: give the same BLIF/--luts)")
+    p.add_argument("--place_file", help="read placement instead of placing")
+    p.add_argument("--out_dir", default="out",
+                   help="directory for .net/.place/.route artifacts")
+    # router opts (names per s_router_opts, vpr_types.h:708-770)
+    p.add_argument("--route_chan_width", type=int, default=0,
+                   help="fixed channel width (0 = arch default; "
+                   "ignored with --binary_search)")
+    p.add_argument("--binary_search", action="store_true",
+                   help="find minimum routable channel width")
+    p.add_argument("--max_router_iterations", type=int, default=50)
+    p.add_argument("--initial_pres_fac", type=float, default=0.5)
+    p.add_argument("--pres_fac_mult", type=float, default=1.3)
+    p.add_argument("--acc_fac", type=float, default=1.0)
+    p.add_argument("--bb_factor", type=int, default=3)
+    p.add_argument("--batch_size", type=int, default=64,
+                   help="nets routed concurrently (replaces --num_threads)")
+    p.add_argument("--sink_group", type=int, default=1)
+    p.add_argument("--no_timing", action="store_true",
+                   help="congestion-driven only (NO_TIMING algorithm)")
+    # placer opts
+    p.add_argument("--moves_per_step", type=int, default=256)
+    p.add_argument("--inner_num", type=float, default=1.0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .arch.builtin import k6_n10_arch, minimal_arch
+    from .flow import (FlowResult, binary_search_route, prepare, run_place,
+                       run_route, save_artifacts)
+    from .netlist.blif import read_blif
+    from .netlist.files import read_net_file, read_place_file
+    from .netlist.generate import generate_circuit
+    from .place.sa import PlacerOpts
+    from .route.router import RouterOpts
+
+    t_flow = time.time()
+    if args.arch == "k6_n10":
+        arch = k6_n10_arch()
+    elif args.arch == "minimal":
+        arch = minimal_arch()
+    else:
+        from .arch.xml_parser import read_arch_xml
+        arch = read_arch_xml(args.arch)
+
+    chan_width = args.route_chan_width or arch.default_chan_width
+
+    if args.blif:
+        nl = read_blif(args.blif)
+        print(f"read {args.blif}: {nl.stats()}")
+    else:
+        nl = generate_circuit(num_luts=args.luts, K=arch.K, seed=args.seed)
+        print(f"synthetic circuit: {nl.stats()}")
+
+    pnl = None
+    if args.net_file:
+        pnl = read_net_file(args.net_file, arch)
+        print(f"packed netlist read from {args.net_file}")
+    flow = prepare(nl, arch, chan_width, seed=args.seed,
+                   bb_factor=args.bb_factor, pnl=pnl)
+    print(f"packed: {flow.pnl.stats()}")
+    print(f"grid: {flow.grid.nx} x {flow.grid.ny} "
+          f"(pack {flow.times['pack']:.2f}s, "
+          f"rr graph {flow.rr.num_nodes} nodes / {flow.rr.num_edges} edges "
+          f"{flow.times['rr_graph']:.2f}s)")
+
+    if args.place_file:
+        from .rr.terminals import net_terminals
+        flow.pos, _, _ = read_place_file(flow.pnl, args.place_file)
+        flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,
+                                  bb_factor=args.bb_factor)
+        print(f"placement read from {args.place_file}")
+    elif not args.no_place:
+        run_place(flow, PlacerOpts(moves_per_step=args.moves_per_step,
+                                   inner_num=args.inner_num,
+                                   seed=args.seed))
+        s = flow.place_stats
+        print(f"placed: cost {s.initial_cost:.1f} -> {s.final_cost:.1f} "
+              f"({len(s.temps)} temps, {s.total_moves} moves, "
+              f"{flow.times['place']:.2f}s)")
+
+    if args.route:
+        ropts = RouterOpts(
+            max_router_iterations=args.max_router_iterations,
+            initial_pres_fac=args.initial_pres_fac,
+            pres_fac_mult=args.pres_fac_mult,
+            acc_fac=args.acc_fac, bb_factor=args.bb_factor,
+            batch_size=args.batch_size, sink_group=args.sink_group)
+        if args.binary_search:
+            wmin = binary_search_route(flow, ropts,
+                                       timing_driven=not args.no_timing)
+            print(f"binary search: W_min = {wmin}")
+        else:
+            run_route(flow, ropts, timing_driven=not args.no_timing)
+        r = flow.route
+        if not r.success:
+            print(f"ROUTING FAILED after {r.iterations} iterations "
+                  f"({r.stats[-1].overused_nodes} overused nodes)")
+            return 1
+        print(f"routed: {r.iterations} iterations, "
+              f"wirelength {r.wirelength}, "
+              f"{flow.times['route']:.2f}s")
+        if not args.no_timing:
+            print(f"critical path: {flow.crit_path_delay * 1e9:.3f} ns")
+
+    paths = save_artifacts(flow, args.out_dir)
+    print("wrote " + " ".join(sorted(paths.values())))
+    print(f"total flow time {time.time() - t_flow:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

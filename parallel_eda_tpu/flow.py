@@ -46,6 +46,7 @@ class FlowResult:
     analyzer: Optional[TimingAnalyzer] = None
     route: Optional[RouteResult] = None
     place_stats: Optional[PlaceStats] = None
+    bb_factor: int = 3
     times: dict = field(default_factory=dict)   # stage -> seconds
 
     @property
@@ -56,10 +57,14 @@ class FlowResult:
 
 def prepare(nl: LogicalNetlist, arch: Arch, chan_width: int,
             seed: int = 0, nx: int = 0, ny: int = 0,
-            bb_factor: int = 3) -> FlowResult:
-    """Front end through initial placement + rr-graph (no SA, no route)."""
+            bb_factor: int = 3,
+            pnl: Optional[PackedNetlist] = None) -> FlowResult:
+    """Front end through initial placement + rr-graph (no SA, no route).
+    Pass ``pnl`` to resume from a packed netlist (.net file) instead of
+    running the packer."""
     t0 = time.time()
-    pnl = pack_netlist(nl, arch)
+    if pnl is None:
+        pnl = pack_netlist(nl, arch)
     t_pack = time.time() - t0
     n_clb = sum(1 for i in range(pnl.num_blocks)
                 if not pnl.block_type(i).is_io)
@@ -71,7 +76,7 @@ def prepare(nl: LogicalNetlist, arch: Arch, chan_width: int,
     t_rr = time.time() - t0
     term = net_terminals(pnl, rr, pos, bb_factor=bb_factor)
     res = FlowResult(arch=arch, nl=nl, pnl=pnl, grid=grid, pos=pos, rr=rr,
-                     term=term)
+                     term=term, bb_factor=bb_factor)
     res.times["pack"] = t_pack
     res.times["rr_graph"] = t_rr
     return res
@@ -98,8 +103,139 @@ def run_place(flow: FlowResult,
     placer = Placer(flow.pnl, flow.grid, opts)
     flow.pos, flow.place_stats = placer.place(flow.pos)
     flow.times["place"] = time.time() - t0
-    flow.term = net_terminals(flow.pnl, flow.rr, flow.pos)
+    flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,
+                              bb_factor=flow.bb_factor)
     return flow
+
+
+def routes_from_result(term: NetTerminals, route: RouteResult,
+                       num_nodes: int) -> dict:
+    """Per-net route trees {packed net index: [(node, parent), ...]} in
+    tree order (SOURCE first, parent -1), from the router's per-sink path
+    segments (each stored sink -> join-node; the join node is already in
+    the tree).  This is the .route file payload (print_route semantics,
+    vpr/SRC/route/route_common.c)."""
+    out = {}
+    for r, ni in enumerate(term.net_ids):
+        src = int(term.source[r])
+        rows = [(src, -1)]
+        in_tree = {src}
+        ns = int(term.num_sinks[r])
+        segs = []
+        for s in range(ns):
+            seg = route.paths[r, s]
+            seg = seg[seg < num_nodes]
+            if seg.size:
+                segs.append(seg)
+        # segments were grown in criticality-wave order, not sink-slot
+        # order: insert each once its join node (seg[-1]) is in the tree
+        while segs:
+            progressed = False
+            rest = []
+            for seg in segs:
+                if int(seg[-1]) in in_tree:
+                    # seg = [sink ... join]; parent of seg[j] is seg[j+1]
+                    for j in range(len(seg) - 2, -1, -1):
+                        node = int(seg[j])
+                        if node in in_tree:
+                            continue
+                        rows.append((node, int(seg[j + 1])))
+                        in_tree.add(node)
+                    progressed = True
+                else:
+                    rest.append(seg)
+            if not progressed:
+                raise ValueError(
+                    f"net {ni}: disconnected route-tree segments")
+            segs = rest
+        out[int(ni)] = rows
+    return out
+
+
+def save_artifacts(flow: FlowResult, out_dir: str,
+                   prefix: Optional[str] = None) -> dict:
+    """Write .net / .place / .route (the flow's checkpoint/resume surface,
+    SURVEY §5.4; vpr_api.c output files).  Returns {kind: path}."""
+    import os
+
+    from .netlist.files import (write_net_file, write_place_file,
+                                write_route_file)
+
+    os.makedirs(out_dir, exist_ok=True)
+    # nl.name may be a file path (BLIF with no .model line): keep only a
+    # safe basename so artifacts always land inside out_dir
+    base = os.path.basename(prefix or flow.nl.name) or "circuit"
+    paths = {}
+    p = os.path.join(out_dir, base + ".net")
+    write_net_file(flow.pnl, p)
+    paths["net"] = p
+    p = os.path.join(out_dir, base + ".place")
+    write_place_file(flow.pnl, flow.pos, flow.grid.nx, flow.grid.ny, p,
+                     net_file=paths["net"])
+    paths["place"] = p
+    if flow.route is not None:
+        routes = routes_from_result(flow.term, flow.route,
+                                    flow.rr.num_nodes)
+        p = os.path.join(out_dir, base + ".route")
+        write_route_file(flow.pnl, flow.rr, routes, p,
+                         flow.grid.nx, flow.grid.ny)
+        paths["route"] = p
+    return paths
+
+
+def binary_search_route(flow: FlowResult,
+                        opts: Optional[RouterOpts] = None,
+                        timing_driven: bool = True,
+                        max_width: int = 0) -> int:
+    """Find the minimum routable channel width W_min (the reference's
+    binary_search_place_and_route, base/place_and_route.c:432): starting
+    from the flow's current width, halve while routable / double while
+    not, then bisect the (failed, routed] bracket.  Leaves the flow
+    routed at W_min and returns it."""
+    last_w = [flow.rr.chan_width if flow.route is not None else -1]
+
+    def attempt(w: int) -> bool:
+        if w != flow.rr.chan_width:
+            flow.rr = build_rr_graph(flow.arch, flow.grid, chan_width=w)
+        flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,
+                                  bb_factor=flow.bb_factor)
+        flow.tg = None          # routed-delay indices depend on term
+        flow.analyzer = None
+        run_route(flow, opts, timing_driven=timing_driven, verify=False)
+        last_w[0] = w
+        return flow.route.success
+
+    w = flow.rr.chan_width
+    if attempt(w):
+        hi = w
+        lo = 0                  # nothing known to fail yet
+        while hi > 1:
+            half = hi // 2
+            if attempt(half):
+                hi = half
+            else:
+                lo = half
+                break
+    else:
+        lo = w
+        while True:
+            w *= 2
+            if max_width and w > max_width:
+                raise RuntimeError(f"unroutable even at W={w // 2}")
+            if attempt(w):
+                hi = w
+                break
+            lo = w
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if attempt(mid):
+            hi = mid
+        else:
+            lo = mid
+    if last_w[0] != hi:
+        attempt(hi)             # leave the flow routed at W_min
+    check_route(flow.rr, flow.term, flow.route.paths, occ=flow.route.occ)
+    return hi
 
 
 def run_route(flow: FlowResult, opts: Optional[RouterOpts] = None,

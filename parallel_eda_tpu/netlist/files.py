@@ -128,3 +128,22 @@ def write_route_file(pnl: PackedNetlist, rr, routes: Dict[int, List[Tuple[int, i
                          else "Pin:" if t in (OPIN, IPIN) else "Track:")
                 f.write(f"Node:\t{node}\t{kind} ({x},{y})  "
                         f"{label} {ptc}  Parent: {parent}\n")
+
+
+def read_route_file(path: str) -> Dict[int, List[Tuple[int, int]]]:
+    """Read back a .route file -> {net index: [(node, parent), ...]}."""
+    routes: Dict[int, List[Tuple[int, int]]] = {}
+    cur: Optional[int] = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("Net "):
+                if line.endswith("global net"):
+                    cur = None
+                else:
+                    cur = int(line.split()[1])
+                    routes[cur] = []
+            elif line.startswith("Node:") and cur is not None:
+                tok = line.split()
+                routes[cur].append((int(tok[1]), int(tok[-1])))
+    return routes

@@ -1,0 +1,89 @@
+"""CLI + artifact + binary-search tests (flow driver surface,
+vpr/SRC/base/place_and_route.c semantics)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.__main__ import main
+from parallel_eda_tpu.flow import (binary_search_route, run_route,
+                                   routes_from_result, save_artifacts,
+                                   synth_flow)
+from parallel_eda_tpu.netlist.files import (read_place_file,
+                                            read_route_file)
+from parallel_eda_tpu.route import RouterOpts
+
+
+def test_cli_full_flow(tmp_path):
+    rc = main(["--luts", "25", "--arch", "minimal",
+               "--route_chan_width", "12", "--batch_size", "16",
+               "--moves_per_step", "16",
+               "--out_dir", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "synth.net").exists()
+    assert (tmp_path / "synth.place").exists()
+    assert (tmp_path / "synth.route").exists()
+
+
+def test_cli_place_file_resume(tmp_path):
+    # run once writing artifacts, then resume routing from the .place file
+    rc = main(["--luts", "25", "--arch", "minimal",
+               "--route_chan_width", "12", "--batch_size", "16",
+               "--moves_per_step", "16", "--out_dir", str(tmp_path)])
+    assert rc == 0
+    rc = main(["--luts", "25", "--arch", "minimal",
+               "--route_chan_width", "12", "--batch_size", "16",
+               "--place_file", str(tmp_path / "synth.place"),
+               "--out_dir", str(tmp_path / "resumed")])
+    assert rc == 0
+    assert (tmp_path / "resumed" / "synth.route").exists()
+
+
+def test_cli_net_file_resume(tmp_path):
+    # pack once, then resume from the .net file (skips the packer)
+    rc = main(["--luts", "25", "--arch", "minimal",
+               "--route_chan_width", "12", "--batch_size", "16",
+               "--moves_per_step", "16", "--out_dir", str(tmp_path)])
+    assert rc == 0
+    rc = main(["--luts", "25", "--arch", "minimal",
+               "--route_chan_width", "12", "--batch_size", "16",
+               "--moves_per_step", "16",
+               "--net_file", str(tmp_path / "synth.net"),
+               "--out_dir", str(tmp_path / "resumed")])
+    assert rc == 0
+    assert (tmp_path / "resumed" / "synth.route").exists()
+
+
+def test_route_file_roundtrip(tmp_path):
+    f = synth_flow(num_luts=25, chan_width=12, seed=2)
+    f = run_route(f, RouterOpts(batch_size=16), timing_driven=False)
+    assert f.route.success
+    paths = save_artifacts(f, str(tmp_path))
+    routes = routes_from_result(f.term, f.route, f.rr.num_nodes)
+    back = read_route_file(paths["route"])
+    assert set(back) == set(routes)
+    for ni in routes:
+        assert back[ni] == routes[ni]
+    # every tree row's parent must precede it (valid tree order), and
+    # sources have parent -1
+    for ni, rows in routes.items():
+        seen = set()
+        for node, parent in rows:
+            assert parent == -1 or parent in seen
+            seen.add(node)
+
+
+def test_binary_search_wmin():
+    f = synth_flow(num_luts=30, chan_width=12, seed=4)
+    wmin = binary_search_route(f, RouterOpts(batch_size=32),
+                               timing_driven=False)
+    assert f.route.success
+    assert f.rr.chan_width == wmin
+    assert wmin >= 1
+    # minimality: one track less must fail
+    if wmin > 1:
+        f2 = synth_flow(num_luts=30, chan_width=wmin - 1, seed=4)
+        f2 = run_route(f2, RouterOpts(batch_size=32), timing_driven=False,
+                       verify=False)
+        assert not f2.route.success
