@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
     # placer opts
     p.add_argument("--moves_per_step", type=int, default=256)
     p.add_argument("--inner_num", type=float, default=1.0)
+    p.add_argument("--timing_tradeoff", type=float, default=0.5,
+                   help="timing vs wirelength weight in placement "
+                   "(0 = pure wirelength)")
     return p
 
 
@@ -116,13 +119,20 @@ def main(argv=None) -> int:
                                   bb_factor=args.bb_factor)
         print(f"placement read from {args.place_file}")
     elif not args.no_place:
-        run_place(flow, PlacerOpts(moves_per_step=args.moves_per_step,
-                                   inner_num=args.inner_num,
-                                   seed=args.seed))
+        run_place(flow,
+                  PlacerOpts(moves_per_step=args.moves_per_step,
+                             inner_num=args.inner_num,
+                             timing_tradeoff=args.timing_tradeoff,
+                             seed=args.seed),
+                  timing_driven=not args.no_timing)
         s = flow.place_stats
+        extra = ""
+        if not args.no_timing and args.timing_tradeoff > 0:
+            extra = (f", est crit path {s.est_crit_path * 1e9:.2f} ns"
+                     f" (lookup {flow.times.get('delay_lookup', 0):.2f}s)")
         print(f"placed: cost {s.initial_cost:.1f} -> {s.final_cost:.1f} "
               f"({len(s.temps)} temps, {s.total_moves} moves, "
-              f"{flow.times['place']:.2f}s)")
+              f"{flow.times['place']:.2f}s{extra})")
 
     if args.route:
         ropts = RouterOpts(

@@ -97,10 +97,28 @@ def synth_flow(num_luts: int = 100, num_inputs: int = 8,
 
 
 def run_place(flow: FlowResult,
-              opts: Optional[PlacerOpts] = None) -> FlowResult:
-    """SA placement; refreshes net terminals for the new positions."""
+              opts: Optional[PlacerOpts] = None,
+              timing_driven: bool = True) -> FlowResult:
+    """SA placement; refreshes net terminals for the new positions.
+
+    Timing-driven mode computes the delay-lookup matrices by routing
+    sample nets (timing_place_lookup.c:981) and feeds lookup-delay STA
+    criticalities into the annealer's cost (PATH_TIMING_DRIVEN_PLACE)."""
+    timing = None
+    opts = opts or PlacerOpts()
+    if timing_driven and opts.timing_tradeoff > 0:
+        from .place.delay_lookup import compute_delay_lookup
+        from .place.sa import PlacerTiming
+
+        t0 = time.time()
+        lookup = compute_delay_lookup(flow.rr)
+        flow.times["delay_lookup"] = time.time() - t0
+        if flow.tg is None:
+            flow.tg = build_timing_graph(flow.nl, flow.pnl, flow.term)
+        timing = PlacerTiming(flow.pnl, lookup, flow.term, flow.tg,
+                              td_place_exp=opts.td_place_exp)
     t0 = time.time()
-    placer = Placer(flow.pnl, flow.grid, opts)
+    placer = Placer(flow.pnl, flow.grid, opts, timing=timing)
     flow.pos, flow.place_stats = placer.place(flow.pos)
     flow.times["place"] = time.time() - t0
     flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,
@@ -219,13 +237,13 @@ def binary_search_route(flow: FlowResult,
     else:
         lo = w
         while True:
-            w *= 2
-            if max_width and w > max_width:
-                raise RuntimeError(f"unroutable even at W={w // 2}")
+            w = min(w * 2, max_width) if max_width else w * 2
             if attempt(w):
                 hi = w
                 break
             lo = w
+            if max_width and w >= max_width:
+                raise RuntimeError(f"unroutable even at W={w}")
     while hi - lo > 1:
         mid = (lo + hi) // 2
         if attempt(mid):
@@ -243,9 +261,11 @@ def run_route(flow: FlowResult, opts: Optional[RouterOpts] = None,
               ) -> FlowResult:
     """Route + STA loop + legality oracle (try_route_new semantics,
     route/route_common.c:298; check_route place_and_route.c:169)."""
-    if timing_driven and flow.tg is None:
-        flow.tg = build_timing_graph(flow.nl, flow.pnl, flow.term)
-        flow.analyzer = TimingAnalyzer(flow.tg)
+    if timing_driven:
+        if flow.tg is None:
+            flow.tg = build_timing_graph(flow.nl, flow.pnl, flow.term)
+        if flow.analyzer is None:
+            flow.analyzer = TimingAnalyzer(flow.tg)
     router = Router(flow.rr, opts)
     t0 = time.time()
     cb = flow.analyzer.timing_cb if timing_driven else None

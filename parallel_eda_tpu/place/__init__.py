@@ -1,3 +1,4 @@
 from .initial import initial_placement
-from .sa import (Placer, PlacerOpts, PlaceStats, build_place_problem,
-                 net_bb_cost)
+from .sa import (Placer, PlacerOpts, PlacerTiming, PlaceStats,
+                 build_place_problem, net_bb_cost, net_td_cost)
+from .delay_lookup import DelayLookup, compute_delay_lookup

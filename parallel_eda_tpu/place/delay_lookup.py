@@ -1,0 +1,173 @@
+"""Placement delay-lookup matrices.
+
+Equivalent of the reference's timing_place_lookup.c:981
+compute_delay_lookup_tables: the placer's timing model is "the delay of a
+best-case route between two blocks depends only on (|dx|, |dy|)", captured
+in small matrices by routing sample two-terminal nets over an *empty*
+device.  Where the reference routes each sample net serially with the L5
+router, here every (dx, dy) offset becomes one net in a single batched
+pure-delay route (criticality 1, zero congestion) — the whole table is a
+couple of device dispatches.
+
+Four matrices mirror the reference's delta_clb_to_clb / io variants; IO
+samples anchor at a representative perimeter tile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..rr.graph import RRGraph
+from ..rr.terminals import NetTerminals
+from ..route.router import Router, RouterOpts
+
+
+@dataclass
+class DelayLookup:
+    clb_clb: np.ndarray     # [nx+1, ny+1] delay at offset (dx, dy)
+    io_clb: np.ndarray      # [nx+2, ny+2]
+    clb_io: np.ndarray      # [nx+2, ny+2]
+    io_io: np.ndarray       # [nx+2, ny+2]
+
+    def conn_delay(self, sx, sy, s_io, tx, ty, t_io):
+        """Vectorized: delay of a connection source (sx,sy) -> sink
+        (tx,ty) with io flags (numpy arrays ok)."""
+        dx = np.abs(np.asarray(tx) - np.asarray(sx))
+        dy = np.abs(np.asarray(ty) - np.asarray(sy))
+        s_io = np.asarray(s_io)
+        t_io = np.asarray(t_io)
+        out = np.where(
+            s_io & t_io, self.io_io[dx, dy],
+            np.where(s_io, self.io_clb[dx, dy],
+                     np.where(t_io, self.clb_io[dx, dy],
+                              self.clb_clb[np.minimum(dx, self.clb_clb.
+                                                      shape[0] - 1),
+                                           np.minimum(dy, self.clb_clb.
+                                                      shape[1] - 1)])))
+        return out.astype(np.float32)
+
+
+def _route_samples(router: Router, rr: RRGraph, pairs) -> np.ndarray:
+    """pairs: list of (src_node, sink_node).  Returns delays [len(pairs)]
+    from one pure-delay batched route on the empty device."""
+    n = len(pairs)
+    term = NetTerminals(
+        net_ids=np.arange(n, dtype=np.int32),
+        source=np.array([p[0] for p in pairs], dtype=np.int32),
+        sinks=np.array([[p[1]] for p in pairs], dtype=np.int32),
+        num_sinks=np.ones(n, dtype=np.int32),
+        bb_xmin=np.zeros(n, dtype=np.int32),
+        bb_xmax=np.full(n, rr.grid.nx + 1, dtype=np.int32),
+        bb_ymin=np.zeros(n, dtype=np.int32),
+        bb_ymax=np.full(n, rr.grid.ny + 1, dtype=np.int32),
+    )
+    crit = np.full((n, 1), 0.99, dtype=np.float32)
+    res = router.route(term, crit=crit)
+    return res.sink_delay[:, 0]
+
+
+def _class_index(rr: RRGraph):
+    """One pass over src_of/sink_of -> {(x, y): (z, class)} per kind."""
+    drv, rcv = {}, {}
+    for (x, y, z, k) in rr.src_of:
+        drv.setdefault((x, y), (z, k))
+    for (x, y, z, k) in rr.sink_of:
+        rcv.setdefault((x, y), (z, k))
+    return drv, rcv
+
+
+def compute_delay_lookup(rr: RRGraph,
+                         opts: RouterOpts | None = None) -> DelayLookup:
+    """Build all four matrices.  The CLB sample source sits at (1, 1); IO
+    sweeps run from TWO anchors — bottom edge (1, 0) and left edge
+    (0, 1) — so both the dx=0 and dy=0 offset rows are really sampled
+    (the reference sweeps source positions for irregular grids; an island
+    grid is translation-invariant up to edge effects,
+    timing_place_lookup.c setup_chan_width/alloc_routing comments)."""
+    import dataclasses
+
+    nx, ny = rr.grid.nx, rr.grid.ny
+    opts = (dataclasses.replace(opts, max_router_iterations=1) if opts
+            else RouterOpts(batch_size=256, max_router_iterations=1))
+    router = Router(rr, opts)
+    drv_of, rcv_of = _class_index(rr)
+
+    def sink_node(x, y):
+        z, k = rcv_of[(x, y)]
+        return rr.sink_of[(x, y, z, k)]
+
+    def src_node(x, y):
+        z, k = drv_of[(x, y)]
+        return rr.src_of[(x, y, z, k)]
+
+    def sweep(src, sink_tiles):
+        pairs = [(src, sink_node(x, y)) for (x, y) in sink_tiles]
+        return _route_samples(router, rr, pairs)
+
+    def tally(mat, seen, anchor, tiles, delays):
+        for (x, y), dd in zip(tiles, delays):
+            dx, dy = abs(x - anchor[0]), abs(y - anchor[1])
+            # offsets repeat across anchors/tiles: keep the best case
+            if not seen[dx, dy] or dd < mat[dx, dy]:
+                mat[dx, dy] = dd
+                seen[dx, dy] = True
+
+    clb_tiles = [(x, y) for x in range(1, nx + 1) for y in range(1, ny + 1)]
+    io_tiles = rr.grid.io_sites()
+    anchors = [(1, 0), (0, 1)]          # bottom edge, left edge
+
+    # clb -> clb (includes dx=dy=0: feedback through routing)
+    clb_clb = np.zeros((nx + 1, ny + 1), dtype=np.float32)
+    seen = np.zeros_like(clb_clb, dtype=bool)
+    tally(clb_clb, seen, (1, 1), clb_tiles,
+          sweep(src_node(1, 1), clb_tiles))
+    _fill(clb_clb, seen)
+
+    # io -> clb from both anchors
+    io_clb = np.zeros((nx + 2, ny + 2), dtype=np.float32)
+    seen = np.zeros_like(io_clb, dtype=bool)
+    for a in anchors:
+        tally(io_clb, seen, a, clb_tiles, sweep(src_node(*a), clb_tiles))
+    _fill(io_clb, seen)
+
+    # clb -> io
+    clb_io = np.zeros((nx + 2, ny + 2), dtype=np.float32)
+    seen = np.zeros_like(clb_io, dtype=bool)
+    tally(clb_io, seen, (1, 1), io_tiles, sweep(src_node(1, 1), io_tiles))
+    _fill(clb_io, seen)
+
+    # io -> io from both anchors
+    io_io = np.zeros((nx + 2, ny + 2), dtype=np.float32)
+    seen = np.zeros_like(io_io, dtype=bool)
+    for a in anchors:
+        io_others = [t for t in io_tiles if t != a]
+        tally(io_io, seen, a, io_others, sweep(src_node(*a), io_others))
+    io_io[0, 0] = 0.0
+    seen[0, 0] = True
+    _fill(io_io, seen)
+
+    return DelayLookup(clb_clb=clb_clb, io_clb=io_clb, clb_io=clb_io,
+                       io_io=io_io)
+
+
+def _fill(mat: np.ndarray, seen: np.ndarray) -> None:
+    """Fill never-sampled offsets from the nearest sampled neighbor
+    (row-major nearest-smaller fallback)."""
+    H, W = mat.shape
+    for dx in range(H):
+        for dy in range(W):
+            if not seen[dx, dy]:
+                if dx and seen[dx - 1, dy]:
+                    mat[dx, dy] = mat[dx - 1, dy]
+                    seen[dx, dy] = True
+                elif dy and seen[dx, dy - 1]:
+                    mat[dx, dy] = mat[dx, dy - 1]
+                    seen[dx, dy] = True
+                elif dx and dy and seen[dx - 1, dy - 1]:
+                    mat[dx, dy] = mat[dx - 1, dy - 1]
+                    seen[dx, dy] = True
+    # second pass for any leftovers (top-left corners etc.)
+    fallback = mat[seen].max() if seen.any() else 0.0
+    mat[~seen] = fallback

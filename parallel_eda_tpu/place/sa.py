@@ -77,7 +77,8 @@ def crossing_factor(num_pins: np.ndarray) -> np.ndarray:
 @struct.dataclass
 class PlaceProblem:
     """Device-resident static placement data (pytree)."""
-    # per-net pin ELL: blocks of each costed net, padded with -1
+    # per-net pin ELL: blocks of each costed net, padded with -1.
+    # slot 0 is the net driver; slots >= 1 are sink blocks (deduped)
     net_blk: jnp.ndarray       # int32 [NN, P]
     net_valid: jnp.ndarray     # bool  [NN, P]
     net_q: jnp.ndarray         # f32   [NN] crossing factor
@@ -86,6 +87,9 @@ class PlaceProblem:
     # block/site model
     is_io: jnp.ndarray         # bool [NB]
     ring_xy: jnp.ndarray       # int32 [NRING, 2] perimeter ring tile coords
+    # timing model: delta-delay matrices (delay_lookup) padded to one
+    # [4, nx+2, ny+2] stack ordered (clb_clb, io_clb, clb_io, io_io)
+    delta: jnp.ndarray         # f32 [4, nx+2, ny+2]
     # static geometry (python ints; hashable side data)
     nx: int = struct.field(pytree_node=False)
     ny: int = struct.field(pytree_node=False)
@@ -109,19 +113,28 @@ class PlacerOpts:
     exit_t_frac: float = 0.005     # exit when t < frac * cost / num_nets
     max_temps: int = 500
     seed: int = 0
+    # timing-driven knobs (PATH_TIMING_DRIVEN_PLACE, place.c comp_td_costs)
+    timing_tradeoff: float = 0.5   # 0 = pure wirelength
+    td_place_exp: float = 8.0      # criticality exponent (td_place_exp_last)
+    recompute_crit_temps: int = 1  # STA recompute cadence (temperatures)
 
 
 @dataclass
 class PlaceStats:
     temps: List[Tuple[float, float, float, float]] = field(
-        default_factory=list)   # (t, cost, success_rate, rlim)
+        default_factory=list)   # (t, bb_cost, success_rate, rlim)
     initial_cost: float = 0.0
     final_cost: float = 0.0
+    final_td_cost: float = 0.0
+    est_crit_path: float = float("nan")  # lookup-delay STA estimate
     total_moves: int = 0
 
 
-def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid) -> PlaceProblem:
-    """Extract the ELL tables the device step needs."""
+def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid,
+                        lookup=None) -> PlaceProblem:
+    """Extract the ELL tables the device step needs.  ``lookup`` is an
+    optional place.delay_lookup.DelayLookup for timing-driven placement
+    (zeros otherwise -> td cost identically 0)."""
     NB = pnl.num_blocks
     costed = [i for i, n in enumerate(pnl.nets)
               if not n.is_global and n.sinks]
@@ -159,10 +172,25 @@ def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid) -> PlaceProblem:
     is_io = np.array([pnl.block_type(i).is_io for i in range(NB)], dtype=bool)
     ring = np.array(grid.io_sites(), dtype=np.int32)
 
+    # delta-delay stack [4, nx+2, ny+2]: (clb_clb, io_clb, clb_io, io_io)
+    H, W = grid.nx + 2, grid.ny + 2
+    delta = np.zeros((4, H, W), dtype=np.float32)
+    if lookup is not None:
+        cc = np.zeros((H, W), dtype=np.float32)
+        hh, ww = lookup.clb_clb.shape
+        cc[:hh, :ww] = lookup.clb_clb
+        cc[hh:, :ww] = lookup.clb_clb[-1]
+        cc[:, ww:] = cc[:, ww - 1:ww]
+        delta[0] = cc
+        delta[1] = lookup.io_clb
+        delta[2] = lookup.clb_io
+        delta[3] = lookup.io_io
+
     return PlaceProblem(
         net_blk=jnp.asarray(net_blk), net_valid=jnp.asarray(net_valid),
         net_q=jnp.asarray(net_q), blk_net=jnp.asarray(blk_net),
         is_io=jnp.asarray(is_io), ring_xy=jnp.asarray(ring),
+        delta=jnp.asarray(delta),
         nx=grid.nx, ny=grid.ny, io_cap=grid.io_capacity,
     )
 
@@ -183,6 +211,27 @@ def _ring_index_host(grid: DeviceGrid) -> dict:
 
 
 # ---------------------------------------------------------------- cost
+
+def _conn_delay(pp: PlaceProblem, sx, sy, s_io, tx, ty, t_io):
+    """Lookup delay source -> sink from the delta stack (broadcasting)."""
+    sel = jnp.where(s_io & t_io, 3,
+                    jnp.where(s_io, 1, jnp.where(t_io, 2, 0)))
+    dx = jnp.clip(jnp.abs(tx - sx), 0, pp.nx + 1)
+    dy = jnp.clip(jnp.abs(ty - sy), 0, pp.ny + 1)
+    return pp.delta[sel, dx, dy]
+
+
+def net_td_cost(pp: PlaceProblem, pos: jnp.ndarray, crit: jnp.ndarray):
+    """Timing cost  sum_conn crit * delay(driver -> sink)  over all costed
+    connections (comp_td_costs place.c semantics; slot 0 = driver)."""
+    blk = jnp.clip(pp.net_blk, 0)
+    x, y = pos[blk, 0], pos[blk, 1]
+    iof = pp.is_io[blk]
+    d = _conn_delay(pp, x[:, :1], y[:, :1], iof[:, :1], x, y, iof)
+    P = pp.net_blk.shape[1]
+    is_sink = (jnp.arange(P)[None, :] > 0) & pp.net_valid
+    return jnp.where(is_sink, crit * d, 0.0).sum()
+
 
 def net_bb_cost(pp: PlaceProblem, pos: jnp.ndarray):
     """Dense bb cost of all costed nets: (cost_total, bb [NN, 4])."""
@@ -232,10 +281,13 @@ def _propose(pp: PlaceProblem, pos, ring_idx, key, rlim, M: int):
 
 
 @functools.partial(jax.jit, static_argnames=("M",))
-def sa_step(pp: PlaceProblem, pos, ring_idx, occ, key, t, rlim, M: int):
+def sa_step(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb, inv_td,
+            tradeoff, key, t, rlim, M: int):
     """One batched SA step: M proposals -> conflict-free subset -> delta
-    evaluation -> Metropolis -> apply.  Returns (pos, ring_idx, occ,
-    n_acc, n_valid, cost_after, delta_sum, delta_sq)."""
+    evaluation -> Metropolis on the normalized combined cost
+    (1-tt)*dbb*inv_bb + tt*dtd*inv_td (place.c delta normalization) ->
+    apply.  Returns (pos, ring_idx, occ, n_acc, n_valid, delta_sum,
+    delta_sq)."""
     NB = pp.num_blocks
     NS = pp.num_sites
     kp, ka = jax.random.split(key)
@@ -287,13 +339,30 @@ def sa_step(pp: PlaceProblem, pos, ring_idx, occ, key, t, rlim, M: int):
     new_c = q * ((nxmax - nxmin + 1) + (nymax - nymin + 1)).astype(
         jnp.float32)
     # old cost of the same nets from current positions
-    oxmin = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 0], big).min(axis=2)
-    oxmax = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 0], -big).max(axis=2)
-    oymin = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 1], big).min(axis=2)
-    oymax = jnp.where(pvalid, pos[jnp.clip(pblk, 0), 1], -big).max(axis=2)
+    opx = pos[jnp.clip(pblk, 0), 0]
+    opy = pos[jnp.clip(pblk, 0), 1]
+    oxmin = jnp.where(pvalid, opx, big).min(axis=2)
+    oxmax = jnp.where(pvalid, opx, -big).max(axis=2)
+    oymin = jnp.where(pvalid, opy, big).min(axis=2)
+    oymax = jnp.where(pvalid, opy, -big).max(axis=2)
     old_c = q * ((oxmax - oxmin + 1) + (oymax - oymin + 1)).astype(
         jnp.float32)
-    delta = jnp.where(nvalid, new_c - old_c, 0.0).sum(axis=1)   # [M]
+    delta_bb = jnp.where(nvalid, new_c - old_c, 0.0).sum(axis=1)   # [M]
+
+    # ---- timing delta: crit * lookup-delay per (driver -> sink) conn ----
+    iofg = pp.is_io[jnp.clip(pblk, 0)]                 # [M, 2F, P]
+    critg = crit[netsc]                                # [M, 2F, P]
+    P = pp.net_blk.shape[1]
+    is_sink = (jnp.arange(P)[None, None, :] > 0) & pvalid
+    d_new = _conn_delay(pp, px[:, :, :1], py[:, :, :1], iofg[:, :, :1],
+                        px, py, iofg)
+    d_old = _conn_delay(pp, opx[:, :, :1], opy[:, :, :1], iofg[:, :, :1],
+                        opx, opy, iofg)
+    delta_td = jnp.where(is_sink, critg * (d_new - d_old),
+                         0.0).sum(axis=(1, 2))                     # [M]
+
+    delta = ((1.0 - tradeoff) * delta_bb * inv_bb
+             + tradeoff * delta_td * inv_td)
 
     # ---- Metropolis ----
     u = jax.random.uniform(ka, (M,))
@@ -322,29 +391,89 @@ def sa_step(pp: PlaceProblem, pos, ring_idx, occ, key, t, rlim, M: int):
 
 
 @functools.partial(jax.jit, static_argnames=("M", "steps"))
-def sa_temperature(pp: PlaceProblem, pos, ring_idx, occ, key, t, rlim,
-                   M: int, steps: int):
+def sa_temperature(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb,
+                   inv_td, tradeoff, key, t, rlim, M: int, steps: int):
     """All steps of one temperature as a lax.scan (single dispatch)."""
     def body(carry, k):
         pos, ring_idx, occ = carry
         pos, ring_idx, occ, na, nv, _, _ = sa_step(
-            pp, pos, ring_idx, occ, k, t, rlim, M)
+            pp, pos, ring_idx, occ, crit, inv_bb, inv_td, tradeoff,
+            k, t, rlim, M)
         return (pos, ring_idx, occ), (na, nv)
     keys = jax.random.split(key, steps)
     (pos, ring_idx, occ), (na, nv) = jax.lax.scan(
         body, (pos, ring_idx, occ), keys)
-    cost, _ = net_bb_cost(pp, pos)
-    return pos, ring_idx, occ, na.sum(), nv.sum(), cost
+    bb_cost, _ = net_bb_cost(pp, pos)
+    td_cost = net_td_cost(pp, pos, crit)
+    return pos, ring_idx, occ, na.sum(), nv.sum(), bb_cost, td_cost
+
+
+class PlacerTiming:
+    """Bundle wiring the placer to the timing subsystem: the delay-lookup
+    matrices plus the STA machinery for criticality recomputation
+    (alloc_lookups_and_criticalities, timing_place.c:121)."""
+
+    def __init__(self, pnl: PackedNetlist, lookup, term, tg,
+                 td_place_exp: float = 8.0):
+        from ..timing.sta import TimingAnalyzer
+
+        self.lookup = lookup
+        self.term = term
+        self.analyzer = TimingAnalyzer(tg, crit_exp=td_place_exp)
+        R, Smax = term.sinks.shape
+        # per-connection block endpoints for lookup-delay evaluation
+        self.drv_blk = np.zeros(R, dtype=np.int32)
+        self.snk_blk = np.zeros((R, Smax), dtype=np.int32)
+        self.conn_valid = np.zeros((R, Smax), dtype=bool)
+        # (r, s) -> (costed-net row, uniq-block slot) for crit scatter
+        self.map_row = np.zeros((R, Smax), dtype=np.int64)
+        self.map_slot = np.zeros((R, Smax), dtype=np.int64)
+        is_io = [pnl.block_type(i).is_io for i in range(pnl.num_blocks)]
+        self.is_io = np.array(is_io)
+        for r, ni in enumerate(term.net_ids):
+            net = pnl.nets[int(ni)]
+            self.drv_blk[r] = net.driver.block
+            uniq = {}
+            uniq[net.driver.block] = 0
+            for p in net.sinks:
+                if p.block not in uniq:
+                    uniq[p.block] = len(uniq)
+            for s, p in enumerate(net.sinks):
+                self.snk_blk[r, s] = p.block
+                self.conn_valid[r, s] = True
+                self.map_row[r, s] = r
+                self.map_slot[r, s] = uniq[p.block]
+
+    def criticalities(self, pos: np.ndarray, NN: int, P: int) -> tuple:
+        """(crit [NN, P], crit_path_delay) for the current positions using
+        lookup delays (load_criticalities timing_place.c:81)."""
+        sx = pos[self.drv_blk, 0][:, None]
+        sy = pos[self.drv_blk, 1][:, None]
+        s_io = self.is_io[self.drv_blk][:, None]
+        tx = pos[self.snk_blk, 0]
+        ty = pos[self.snk_blk, 1]
+        t_io = self.is_io[self.snk_blk]
+        d = self.lookup.conn_delay(sx, sy, s_io, tx, ty, t_io)
+        d = np.where(self.conn_valid, d, 0.0)
+        crit_rs = self.analyzer.analyze(d)
+        crit = np.zeros((NN, P), dtype=np.float32)
+        np.maximum.at(crit, (self.map_row[self.conn_valid],
+                             self.map_slot[self.conn_valid]),
+                      crit_rs[self.conn_valid])
+        return crit, self.analyzer.crit_path_delay
 
 
 class Placer:
     """Host driver owning the annealing schedule (place.c:310 try_place)."""
 
     def __init__(self, pnl: PackedNetlist, grid: DeviceGrid,
-                 opts: Optional[PlacerOpts] = None):
+                 opts: Optional[PlacerOpts] = None,
+                 timing: Optional[PlacerTiming] = None):
         self.pnl, self.grid = pnl, grid
         self.opts = opts or PlacerOpts()
-        self.pp = build_place_problem(pnl, grid)
+        self.timing = timing
+        self.pp = build_place_problem(
+            pnl, grid, lookup=timing.lookup if timing else None)
         self._ring_of = _ring_index_host(grid)
 
     def _state_from_pos(self, pos_np: np.ndarray):
@@ -364,36 +493,61 @@ class Placer:
         occ[site] = np.arange(NB)
         return pos, ring_j, jnp.asarray(occ)
 
+    def _crit(self, pos_np: np.ndarray):
+        pp = self.pp
+        NN, P = pp.net_blk.shape
+        if self.timing is None:
+            return jnp.zeros((NN, P), jnp.float32), float("nan")
+        crit, cpd = self.timing.criticalities(pos_np, NN, P)
+        return jnp.asarray(crit), cpd
+
     def place(self, pos0: np.ndarray) -> Tuple[np.ndarray, PlaceStats]:
         opts, pp = self.opts, self.pp
         NB = self.pnl.num_blocks
         NN = pp.net_blk.shape[0]
+        tt = jnp.float32(opts.timing_tradeoff if self.timing else 0.0)
         M = min(opts.moves_per_step, max(8, NB))
         steps = max(1, math.ceil(opts.inner_num * NB ** (4 / 3) / M))
         pos, ring, occ = self._state_from_pos(pos0)
         key = jax.random.PRNGKey(opts.seed)
 
-        cost0, _ = net_bb_cost(pp, pos)
-        stats = PlaceStats(initial_cost=float(cost0))
+        crit, _ = self._crit(pos0)
+        bb_cost, _ = net_bb_cost(pp, pos)
+        td_cost = net_td_cost(pp, pos, crit)
+        bb_cost, td_cost = float(bb_cost), float(td_cost)
+        stats = PlaceStats(initial_cost=bb_cost)
+
+        def norms():
+            # inverse-cost normalization, recomputed per temperature
+            # (place.c inverse_prev_bb_cost / inverse_prev_timing_cost)
+            return (jnp.float32(1.0 / max(bb_cost, 1e-30)),
+                    jnp.float32(1.0 / max(td_cost, 1e-30)))
 
         # starting_t (place.c:506): std-dev of random-move deltas at t=inf
         key, k = jax.random.split(key)
+        inv_bb, inv_td = norms()
         _, _, _, _, nv, dsum, dsq = sa_step(
-            pp, pos, ring, occ, k, jnp.float32(1e30), jnp.float32(
-                max(pp.nx, pp.ny)), M)
+            pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
+            jnp.float32(1e30), jnp.float32(max(pp.nx, pp.ny)), M)
         nv = max(1, int(nv))
         var = float(dsq) / nv - (float(dsum) / nv) ** 2
         t = 20.0 * math.sqrt(max(var, 1e-12))
         rlim = float(max(pp.nx, pp.ny))
 
-        for _ in range(opts.max_temps):
+        for temp_i in range(opts.max_temps):
+            if self.timing is not None and \
+                    temp_i % max(1, opts.recompute_crit_temps) == 0:
+                crit, _ = self._crit(np.asarray(pos))
+                td_cost = float(net_td_cost(pp, pos, crit))
+            inv_bb, inv_td = norms()
             key, k = jax.random.split(key)
-            pos, ring, occ, na, nv, cost = sa_temperature(
-                pp, pos, ring, occ, k, jnp.float32(t), jnp.float32(rlim),
-                M, steps)
-            na, nv, cost = int(na), int(nv), float(cost)
+            pos, ring, occ, na, nv, bbc, tdc = sa_temperature(
+                pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
+                jnp.float32(t), jnp.float32(rlim), M, steps)
+            na, nv = int(na), int(nv)
+            bb_cost, td_cost = float(bbc), float(tdc)
             srat = na / max(1, nv)
-            stats.temps.append((t, cost, srat, rlim))
+            stats.temps.append((t, bb_cost, srat, rlim))
             stats.total_moves += nv
             # update_t / update_rlim (place.c:265)
             if srat > 0.96:
@@ -406,13 +560,18 @@ class Placer:
                 t *= 0.8
             rlim = min(max(pp.nx, pp.ny),
                        max(1.0, rlim * (1.0 - 0.44 + srat)))
-            if t < opts.exit_t_frac * cost / max(1, NN):
+            # exit_crit (place.c:270) on the normalized combined cost (~1)
+            if t < opts.exit_t_frac / max(1, NN):
                 break
 
         # final quench at t=0
         key, k = jax.random.split(key)
-        pos, ring, occ, _, _, cost = sa_temperature(
-            pp, pos, ring, occ, k, jnp.float32(0.0), jnp.float32(1.0),
-            M, steps)
-        stats.final_cost = float(cost)
+        inv_bb, inv_td = norms()
+        pos, ring, occ, _, _, bbc, tdc = sa_temperature(
+            pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
+            jnp.float32(0.0), jnp.float32(1.0), M, steps)
+        stats.final_cost = float(bbc)
+        stats.final_td_cost = float(tdc)
+        if self.timing is not None:
+            _, stats.est_crit_path = self._crit(np.asarray(pos))
         return np.asarray(pos), stats

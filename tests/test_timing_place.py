@@ -1,0 +1,50 @@
+"""Timing-driven placement tests: delay-lookup sanity + the placer's
+timing cost actually pulling critical connections together (SURVEY §2.3
+timing_place_lookup / timing_place rows)."""
+
+import numpy as np
+
+from parallel_eda_tpu.flow import run_place, run_route, synth_flow
+from parallel_eda_tpu.place import PlacerOpts, compute_delay_lookup
+from parallel_eda_tpu.route import RouterOpts
+
+
+def test_delay_lookup_monotone():
+    f = synth_flow(num_luts=25, chan_width=12, seed=3)
+    lk = compute_delay_lookup(f.rr)
+    cc = lk.clb_clb
+    assert cc.shape == (f.grid.nx + 1, f.grid.ny + 1)
+    assert np.all(np.isfinite(cc)) and np.all(cc >= 0)
+    # delay along an axis must not shrink with distance (best-case routes)
+    assert cc[-1, 0] >= cc[1, 0] * 0.99
+    assert cc[0, -1] >= cc[0, 1] * 0.99
+    # io tables populated
+    assert np.all(np.isfinite(lk.io_clb)) and lk.io_clb.max() > 0
+    assert np.all(np.isfinite(lk.clb_io)) and lk.clb_io.max() > 0
+
+
+def test_timing_driven_place_runs_and_estimates():
+    f = synth_flow(num_luts=30, chan_width=12, seed=2)
+    f = run_place(f, PlacerOpts(moves_per_step=32, seed=1,
+                                timing_tradeoff=0.5))
+    s = f.place_stats
+    assert np.isfinite(s.est_crit_path) and s.est_crit_path > 0
+    assert s.final_td_cost >= 0
+    assert s.final_cost <= s.initial_cost  # wirelength still improves
+
+
+def test_timing_place_not_worse_than_wirelength_place():
+    # end-to-end: timing-driven placement should give a routed crit path
+    # no worse than wirelength-only placement (within tolerance)
+    def routed_cpd(tt):
+        f = synth_flow(num_luts=40, chan_width=14, seed=6)
+        f = run_place(f, PlacerOpts(moves_per_step=64, seed=3,
+                                    timing_tradeoff=tt),
+                      timing_driven=tt > 0)
+        f = run_route(f, RouterOpts(batch_size=32))
+        assert f.route.success
+        return f.crit_path_delay
+
+    cpd_wl = routed_cpd(0.0)
+    cpd_td = routed_cpd(0.5)
+    assert cpd_td <= cpd_wl * 1.15
