@@ -1,19 +1,27 @@
-"""Multi-chip routing: net-parallel sharding over a jax.sharding.Mesh.
+"""Multi-chip routing: net- and node-parallel sharding over a device Mesh.
 
 TPU-native replacement for the reference's entire distributed stack
-(SURVEY §2.8): where the MPI flagship router
-(vpr/SRC/parallel_route/mpi_route_load_balanced_nonblocking_send_recv_encoded
-.cxx:402) partitions nets across ranks and broadcasts bit-packed path
-packets via nonblocking sends, here the net batch is sharded over the mesh's
-"net" axis, the rr-graph and congestion state are replicated, and the
-per-net usage masks are combined into a global occupancy delta with one
-deterministic psum over ICI.  The encoded-path protocol, rank
-repartitioning, and communicator-halving machinery collapse into XLA's
-collective insertion; determinism is inherent (fixed reduction order).
+(SURVEY §2.8).  Two mesh axes map its two distribution strategies:
 
-Net partitioning across devices is static round-robin here (the analogue of
-the reference's load-balanced `partition:74` by num_sinks is achieved by
-the caller pre-sorting nets by fanout, which this module preserves).
+- "net" axis = the MPI flagship's net partitioning
+  (mpi_route_load_balanced_nonblocking_send_recv_encoded.cxx:402): the
+  batch of nets is split across devices; instead of broadcasting
+  bit-packed rip-up/add path packets via nonblocking sends, the per-net
+  usage masks are summed into a global occupancy delta by one
+  deterministic psum over ICI.
+- "node" axis = the rr-graph spatial partitioning
+  (rr_graph_partitioner.h:840, mpi_spatial_route*.cxx): the graph's ELL
+  arrays, congestion state, and the [B, N] search state are sharded over
+  rr-nodes.  Where the reference maintains boundary nodes and pseudo
+  sources/sinks (route.h:330-365) with explicit messaging, here the
+  sharding annotations let XLA/GSPMD insert the halo communication for
+  the pull-relaxation's cross-shard gathers (the scaling-book recipe:
+  pick a mesh, annotate, let the compiler place collectives; a hand-tuned
+  ppermute halo-exchange pallas kernel is a later optimization).
+
+Determinism is inherent: fixed mesh, fixed reduction order.  The
+communicator-halving machinery (MPI_Comm_split on plateau) collapses into
+re-jitting with a smaller mesh if ever needed.
 """
 
 from __future__ import annotations
@@ -30,14 +38,22 @@ from ..route.device_graph import DeviceRRGraph
 from ..route.search import (congestion_cost, route_net_batch,
                             usage_from_paths)
 
+NET, NODE = "net", "node"
+
 
 def make_mesh(n_devices: Optional[int] = None,
-              axis: str = "net") -> Mesh:
-    """1-D device mesh over the first n_devices jax devices."""
+              shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """2-D (net, node) mesh over the first devices.  shape=None puts all
+    devices on the net axis (pure net parallelism)."""
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
-    return Mesh(np.array(devs), (axis,))
+    n = len(devs)
+    if shape is None:
+        shape = (n, 1)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    return Mesh(np.array(devs).reshape(shape), (NET, NODE))
 
 
 @functools.partial(
@@ -49,8 +65,8 @@ def _route_and_commit(dev: DeviceRRGraph, occ, acc, pres_fac,
                       group: int):
     """One sharded route step: rip up the batch's previous paths, route
     every net against the resulting occupancy view, commit the new
-    occupancy.  All [B, ...] inputs may be sharded over the mesh "net"
-    axis; occ/acc/dev are replicated; the two usage sums become psums."""
+    occupancy.  [B, ...] inputs are sharded over "net"; [.., N] arrays
+    over "node"; the cross-shard sums become psums."""
     N = dev.num_nodes
     nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
     old_usage = usage_from_paths(prev_paths, nodes_p1)
@@ -70,36 +86,51 @@ def _route_and_commit(dev: DeviceRRGraph, occ, acc, pres_fac,
 
 
 class ShardedRouter:
-    """Thin wrapper binding a mesh + shardings to the route step.
+    """Binds a (net, node) mesh to the route step via input shardings;
+    GSPMD propagates them through the jitted program."""
 
-    Usage mirrors route.Router's inner batch call, but batches are laid out
-    across devices: batch axis 0 sharded over mesh axis "net"."""
-
-    def __init__(self, mesh: Mesh, axis: str = "net"):
+    def __init__(self, mesh: Mesh):
         self.mesh = mesh
-        self.axis = axis
-        self.batch_sharding = NamedSharding(mesh, P(axis))
-        self.repl = NamedSharding(mesh, P())
+        self.s_batch = NamedSharding(mesh, P(NET))          # [B, ...]
+        self.s_node = NamedSharding(mesh, P(NODE))          # [N]
+        self.s_node_ell = NamedSharding(mesh, P(NODE, None))  # [N, D]
 
-    def shard_batch(self, *arrays):
-        return tuple(jax.device_put(a, self.batch_sharding) for a in arrays)
-
-    def replicate(self, *arrays):
-        return tuple(jax.device_put(a, self.repl) for a in arrays)
+    def shard_graph(self, dev: DeviceRRGraph) -> DeviceRRGraph:
+        """Place the rr-graph: ELL tables + node properties over "node"."""
+        put = jax.device_put
+        return DeviceRRGraph(
+            ell_src=put(dev.ell_src, self.s_node_ell),
+            ell_delay=put(dev.ell_delay, self.s_node_ell),
+            ell_valid=put(dev.ell_valid, self.s_node_ell),
+            cong_base=put(dev.cong_base, self.s_node),
+            capacity=put(dev.capacity, self.s_node),
+            xlow=put(dev.xlow, self.s_node),
+            xhigh=put(dev.xhigh, self.s_node),
+            ylow=put(dev.ylow, self.s_node),
+            yhigh=put(dev.yhigh, self.s_node),
+            is_wire=put(dev.is_wire, self.s_node),
+        )
 
     def route_step(self, dev: DeviceRRGraph, occ, acc, pres_fac,
                    prev_paths, source, sinks, bb, crit, net_key, valid,
                    max_steps: int, max_len: int, num_waves: int,
                    group: int = 1):
-        """Batch size must be divisible by the mesh size."""
+        """Batch size must be divisible by the mesh's net-axis size."""
         B = source.shape[0]
-        n_dev = self.mesh.devices.size
-        if B % n_dev:
-            raise ValueError(f"batch {B} not divisible by mesh {n_dev}")
-        (prev_paths, source, sinks, bb, crit, net_key,
-         valid) = self.shard_batch(prev_paths, source, sinks, bb, crit,
-                                   net_key, valid)
-        occ, acc = self.replicate(occ, acc)
+        n_net = self.mesh.shape[NET]
+        if B % n_net:
+            raise ValueError(f"batch {B} not divisible by net axis "
+                             f"{n_net}")
+        put = jax.device_put
+        prev_paths = put(prev_paths, self.s_batch)
+        source = put(source, self.s_batch)
+        sinks = put(sinks, self.s_batch)
+        bb = put(bb, self.s_batch)
+        crit = put(crit, self.s_batch)
+        net_key = put(net_key, self.s_batch)
+        valid = put(valid, self.s_batch)
+        occ = put(occ, self.s_node)
+        acc = put(acc, self.s_node)
         return _route_and_commit(
             dev, occ, acc, pres_fac, prev_paths, source, sinks, bb, crit,
             net_key, valid, max_steps, max_len, num_waves, group)
@@ -110,6 +141,7 @@ def route_step_sharded(mesh: Mesh, dev: DeviceRRGraph, occ, acc, pres_fac,
                        max_steps: int, max_len: int, num_waves: int,
                        group: int = 1):
     """Functional convenience wrapper around ShardedRouter.route_step."""
-    return ShardedRouter(mesh).route_step(
-        dev, occ, acc, pres_fac, prev_paths, source, sinks, bb, crit,
-        net_key, valid, max_steps, max_len, num_waves, group)
+    r = ShardedRouter(mesh)
+    return r.route_step(
+        r.shard_graph(dev), occ, acc, pres_fac, prev_paths, source, sinks,
+        bb, crit, net_key, valid, max_steps, max_len, num_waves, group)

@@ -5,12 +5,15 @@ compute_delay_lookup_tables: the placer's timing model is "the delay of a
 best-case route between two blocks depends only on (|dx|, |dy|)", captured
 in small matrices by routing sample two-terminal nets over an *empty*
 device.  Where the reference routes each sample net serially with the L5
-router, here every (dx, dy) offset becomes one net in a single batched
-pure-delay route (criticality 1, zero congestion) — the whole table is a
-couple of device dispatches.
+router, here ALL sample nets (every offset of every source/sink kind pair)
+are concatenated into one batched pure-delay route (criticality 1, zero
+congestion): one shape, one compile, a few device dispatches.
 
-Four matrices mirror the reference's delta_clb_to_clb / io variants; IO
-samples anchor at a representative perimeter tile.
+The four kind matrices (clb_clb, io_clb, clb_io, io_io — the reference's
+delta_* tables) are exposed ONLY as one edge-padded stack [4, nx+2, ny+2];
+both the host criticality path (conn_delay) and the annealer's device cost
+kernel (sa._conn_delay) index this same array, so the two timing views
+cannot drift.
 """
 
 from __future__ import annotations
@@ -26,32 +29,26 @@ from ..route.router import Router, RouterOpts
 
 @dataclass
 class DelayLookup:
-    clb_clb: np.ndarray     # [nx+1, ny+1] delay at offset (dx, dy)
-    io_clb: np.ndarray      # [nx+2, ny+2]
-    clb_io: np.ndarray      # [nx+2, ny+2]
-    io_io: np.ndarray       # [nx+2, ny+2]
+    """stack[kind, |dx|, |dy|]; kind: 0 clb->clb, 1 io->clb, 2 clb->io,
+    3 io->io.  Shape [4, nx+2, ny+2], edge-padded where unsampled."""
+    stack: np.ndarray
 
     def conn_delay(self, sx, sy, s_io, tx, ty, t_io):
-        """Vectorized: delay of a connection source (sx,sy) -> sink
-        (tx,ty) with io flags (numpy arrays ok)."""
-        dx = np.abs(np.asarray(tx) - np.asarray(sx))
-        dy = np.abs(np.asarray(ty) - np.asarray(sy))
+        """Vectorized connection delay source -> sink (numpy arrays ok);
+        the same select/clip the device kernel (sa._conn_delay) uses."""
+        H, W = self.stack.shape[1], self.stack.shape[2]
+        dx = np.minimum(np.abs(np.asarray(tx) - np.asarray(sx)), H - 1)
+        dy = np.minimum(np.abs(np.asarray(ty) - np.asarray(sy)), W - 1)
         s_io = np.asarray(s_io)
         t_io = np.asarray(t_io)
-        out = np.where(
-            s_io & t_io, self.io_io[dx, dy],
-            np.where(s_io, self.io_clb[dx, dy],
-                     np.where(t_io, self.clb_io[dx, dy],
-                              self.clb_clb[np.minimum(dx, self.clb_clb.
-                                                      shape[0] - 1),
-                                           np.minimum(dy, self.clb_clb.
-                                                      shape[1] - 1)])))
-        return out.astype(np.float32)
+        sel = np.where(s_io & t_io, 3,
+                       np.where(s_io, 1, np.where(t_io, 2, 0)))
+        return self.stack[sel, dx, dy].astype(np.float32)
 
 
 def _route_samples(router: Router, rr: RRGraph, pairs) -> np.ndarray:
-    """pairs: list of (src_node, sink_node).  Returns delays [len(pairs)]
-    from one pure-delay batched route on the empty device."""
+    """pairs: [(src_node, sink_node)].  One pure-delay batched route on
+    the empty device -> delays (np.nan where unroutable)."""
     n = len(pairs)
     term = NetTerminals(
         net_ids=np.arange(n, dtype=np.int32),
@@ -65,7 +62,9 @@ def _route_samples(router: Router, rr: RRGraph, pairs) -> np.ndarray:
     )
     crit = np.full((n, 1), 0.99, dtype=np.float32)
     res = router.route(term, crit=crit)
-    return res.sink_delay[:, 0]
+    d = res.sink_delay[:, 0].copy()
+    d[~np.isfinite(d)] = np.nan
+    return d
 
 
 def _class_index(rr: RRGraph):
@@ -80,12 +79,12 @@ def _class_index(rr: RRGraph):
 
 def compute_delay_lookup(rr: RRGraph,
                          opts: RouterOpts | None = None) -> DelayLookup:
-    """Build all four matrices.  The CLB sample source sits at (1, 1); IO
-    sweeps run from TWO anchors — bottom edge (1, 0) and left edge
-    (0, 1) — so both the dx=0 and dy=0 offset rows are really sampled
-    (the reference sweeps source positions for irregular grids; an island
-    grid is translation-invariant up to edge effects,
-    timing_place_lookup.c setup_chan_width/alloc_routing comments)."""
+    """Build the stack.  The CLB sample source sits at (1, 1); IO sweeps
+    run from TWO anchors — bottom edge (1, 0) and left edge (0, 1) — so
+    both the dx=0 and dy=0 offset rows are really sampled (the reference
+    sweeps source positions for irregular grids; an island grid is
+    translation-invariant up to edge effects, timing_place_lookup.c
+    setup_chan_width comments)."""
     import dataclasses
 
     nx, ny = rr.grid.nx, rr.grid.ny
@@ -94,62 +93,65 @@ def compute_delay_lookup(rr: RRGraph,
     router = Router(rr, opts)
     drv_of, rcv_of = _class_index(rr)
 
-    def sink_node(x, y):
-        z, k = rcv_of[(x, y)]
-        return rr.sink_of[(x, y, z, k)]
+    def sink_node(x, y, z=None):
+        zz, k = rcv_of[(x, y)]
+        z = zz if z is None else z
+        return rr.sink_of.get((x, y, z, k))
 
     def src_node(x, y):
         z, k = drv_of[(x, y)]
         return rr.src_of[(x, y, z, k)]
 
-    def sweep(src, sink_tiles):
-        pairs = [(src, sink_node(x, y)) for (x, y) in sink_tiles]
-        return _route_samples(router, rr, pairs)
-
-    def tally(mat, seen, anchor, tiles, delays):
-        for (x, y), dd in zip(tiles, delays):
-            dx, dy = abs(x - anchor[0]), abs(y - anchor[1])
-            # offsets repeat across anchors/tiles: keep the best case
-            if not seen[dx, dy] or dd < mat[dx, dy]:
-                mat[dx, dy] = dd
-                seen[dx, dy] = True
-
     clb_tiles = [(x, y) for x in range(1, nx + 1) for y in range(1, ny + 1)]
     io_tiles = rr.grid.io_sites()
     anchors = [(1, 0), (0, 1)]          # bottom edge, left edge
 
-    # clb -> clb (includes dx=dy=0: feedback through routing)
-    clb_clb = np.zeros((nx + 1, ny + 1), dtype=np.float32)
-    seen = np.zeros_like(clb_clb, dtype=bool)
-    tally(clb_clb, seen, (1, 1), clb_tiles,
-          sweep(src_node(1, 1), clb_tiles))
-    _fill(clb_clb, seen)
+    # ---- assemble every sample as (kind, anchor, tile, src, sink) ----
+    samples = []
 
-    # io -> clb from both anchors
-    io_clb = np.zeros((nx + 2, ny + 2), dtype=np.float32)
-    seen = np.zeros_like(io_clb, dtype=bool)
+    def add(kind, anchor, tiles, src):
+        for t in tiles:
+            samples.append((kind, anchor, t, src, sink_node(*t)))
+
+    add(0, (1, 1), clb_tiles, src_node(1, 1))
     for a in anchors:
-        tally(io_clb, seen, a, clb_tiles, sweep(src_node(*a), clb_tiles))
-    _fill(io_clb, seen)
-
-    # clb -> io
-    clb_io = np.zeros((nx + 2, ny + 2), dtype=np.float32)
-    seen = np.zeros_like(clb_io, dtype=bool)
-    tally(clb_io, seen, (1, 1), io_tiles, sweep(src_node(1, 1), io_tiles))
-    _fill(clb_io, seen)
-
-    # io -> io from both anchors
-    io_io = np.zeros((nx + 2, ny + 2), dtype=np.float32)
-    seen = np.zeros_like(io_io, dtype=bool)
+        add(1, a, clb_tiles, src_node(*a))
+    add(2, (1, 1), io_tiles, src_node(1, 1))
     for a in anchors:
-        io_others = [t for t in io_tiles if t != a]
-        tally(io_io, seen, a, io_others, sweep(src_node(*a), io_others))
-    io_io[0, 0] = 0.0
-    seen[0, 0] = True
-    _fill(io_io, seen)
+        add(3, a, [t for t in io_tiles if t != a], src_node(*a))
+    # same-tile io -> io (dx=dy=0) through a second subtile, if any
+    same_io = None
+    if rr.grid.io_capacity > 1:
+        s1 = sink_node(1, 0, z=1)
+        if s1 is not None:
+            same_io = len(samples)
+            samples.append((3, (1, 0), (1, 0), src_node(1, 0), s1))
 
-    return DelayLookup(clb_clb=clb_clb, io_clb=io_clb, clb_io=clb_io,
-                       io_io=io_io)
+    delays = _route_samples(router, rr, [(s[3], s[4]) for s in samples])
+
+    # ---- tally into the stack, best-case per (kind, |dx|, |dy|) ----
+    H, W = nx + 2, ny + 2
+    stack = np.zeros((4, H, W), dtype=np.float32)
+    seen = np.zeros((4, H, W), dtype=bool)
+    for (kind, anchor, (x, y), _, _), dd in zip(samples, delays):
+        if not np.isfinite(dd):
+            continue                    # unroutable sample: leave unsampled
+        dx, dy = abs(x - anchor[0]), abs(y - anchor[1])
+        if not seen[kind, dx, dy] or dd < stack[kind, dx, dy]:
+            stack[kind, dx, dy] = dd
+            seen[kind, dx, dy] = True
+    if same_io is None:
+        # single-occupancy io tiles: (0,0) unused; keep it harmless
+        if not seen[3, 0, 0]:
+            stack[3, 0, 0] = 0.0
+            seen[3, 0, 0] = True
+    if not seen.any(axis=(1, 2)).all():
+        missing = [k for k in range(4) if not seen[k].any()]
+        raise RuntimeError(
+            f"delay lookup: no routable samples for kinds {missing}")
+    for k in range(4):
+        _fill(stack[k], seen[k])
+    return DelayLookup(stack=stack)
 
 
 def _fill(mat: np.ndarray, seen: np.ndarray) -> None:

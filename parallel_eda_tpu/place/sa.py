@@ -172,19 +172,14 @@ def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid,
     is_io = np.array([pnl.block_type(i).is_io for i in range(NB)], dtype=bool)
     ring = np.array(grid.io_sites(), dtype=np.int32)
 
-    # delta-delay stack [4, nx+2, ny+2]: (clb_clb, io_clb, clb_io, io_io)
+    # delta-delay stack [4, nx+2, ny+2]: (clb_clb, io_clb, clb_io, io_io);
+    # the SAME array the host criticality path indexes (DelayLookup.stack)
     H, W = grid.nx + 2, grid.ny + 2
-    delta = np.zeros((4, H, W), dtype=np.float32)
     if lookup is not None:
-        cc = np.zeros((H, W), dtype=np.float32)
-        hh, ww = lookup.clb_clb.shape
-        cc[:hh, :ww] = lookup.clb_clb
-        cc[hh:, :ww] = lookup.clb_clb[-1]
-        cc[:, ww:] = cc[:, ww - 1:ww]
-        delta[0] = cc
-        delta[1] = lookup.io_clb
-        delta[2] = lookup.clb_io
-        delta[3] = lookup.io_io
+        delta = np.asarray(lookup.stack, dtype=np.float32)
+        assert delta.shape == (4, H, W), (delta.shape, (4, H, W))
+    else:
+        delta = np.zeros((4, H, W), dtype=np.float32)
 
     return PlaceProblem(
         net_blk=jnp.asarray(net_blk), net_valid=jnp.asarray(net_valid),
@@ -280,14 +275,15 @@ def _propose(pp: PlaceProblem, pos, ring_idx, key, rlim, M: int):
     return b, npos, nring
 
 
-@functools.partial(jax.jit, static_argnames=("M",))
+@functools.partial(jax.jit, static_argnames=("M", "timing"))
 def sa_step(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb, inv_td,
-            tradeoff, key, t, rlim, M: int):
+            tradeoff, key, t, rlim, M: int, timing: bool = False):
     """One batched SA step: M proposals -> conflict-free subset -> delta
     evaluation -> Metropolis on the normalized combined cost
     (1-tt)*dbb*inv_bb + tt*dtd*inv_td (place.c delta normalization) ->
-    apply.  Returns (pos, ring_idx, occ, n_acc, n_valid, delta_sum,
-    delta_sq)."""
+    apply.  ``timing`` statically gates the per-connection delay gathers
+    so pure-wirelength placement doesn't pay for them.  Returns (pos,
+    ring_idx, occ, n_acc, n_valid, delta_sum, delta_sq)."""
     NB = pp.num_blocks
     NS = pp.num_sites
     kp, ka = jax.random.split(key)
@@ -350,19 +346,21 @@ def sa_step(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb, inv_td,
     delta_bb = jnp.where(nvalid, new_c - old_c, 0.0).sum(axis=1)   # [M]
 
     # ---- timing delta: crit * lookup-delay per (driver -> sink) conn ----
-    iofg = pp.is_io[jnp.clip(pblk, 0)]                 # [M, 2F, P]
-    critg = crit[netsc]                                # [M, 2F, P]
-    P = pp.net_blk.shape[1]
-    is_sink = (jnp.arange(P)[None, None, :] > 0) & pvalid
-    d_new = _conn_delay(pp, px[:, :, :1], py[:, :, :1], iofg[:, :, :1],
-                        px, py, iofg)
-    d_old = _conn_delay(pp, opx[:, :, :1], opy[:, :, :1], iofg[:, :, :1],
-                        opx, opy, iofg)
-    delta_td = jnp.where(is_sink, critg * (d_new - d_old),
-                         0.0).sum(axis=(1, 2))                     # [M]
-
-    delta = ((1.0 - tradeoff) * delta_bb * inv_bb
-             + tradeoff * delta_td * inv_td)
+    if timing:
+        iofg = pp.is_io[jnp.clip(pblk, 0)]             # [M, 2F, P]
+        critg = crit[netsc]                            # [M, 2F, P]
+        P = pp.net_blk.shape[1]
+        is_sink = (jnp.arange(P)[None, None, :] > 0) & pvalid
+        d_new = _conn_delay(pp, px[:, :, :1], py[:, :, :1],
+                            iofg[:, :, :1], px, py, iofg)
+        d_old = _conn_delay(pp, opx[:, :, :1], opy[:, :, :1],
+                            iofg[:, :, :1], opx, opy, iofg)
+        delta_td = jnp.where(is_sink, critg * (d_new - d_old),
+                             0.0).sum(axis=(1, 2))                 # [M]
+        delta = ((1.0 - tradeoff) * delta_bb * inv_bb
+                 + tradeoff * delta_td * inv_td)
+    else:
+        delta = delta_bb * inv_bb
 
     # ---- Metropolis ----
     u = jax.random.uniform(ka, (M,))
@@ -390,21 +388,22 @@ def sa_step(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb, inv_td,
             dvalid.sum(), (dvalid * dvalid).sum())
 
 
-@functools.partial(jax.jit, static_argnames=("M", "steps"))
+@functools.partial(jax.jit, static_argnames=("M", "steps", "timing"))
 def sa_temperature(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb,
-                   inv_td, tradeoff, key, t, rlim, M: int, steps: int):
+                   inv_td, tradeoff, key, t, rlim, M: int, steps: int,
+                   timing: bool = False):
     """All steps of one temperature as a lax.scan (single dispatch)."""
     def body(carry, k):
         pos, ring_idx, occ = carry
         pos, ring_idx, occ, na, nv, _, _ = sa_step(
             pp, pos, ring_idx, occ, crit, inv_bb, inv_td, tradeoff,
-            k, t, rlim, M)
+            k, t, rlim, M, timing)
         return (pos, ring_idx, occ), (na, nv)
     keys = jax.random.split(key, steps)
     (pos, ring_idx, occ), (na, nv) = jax.lax.scan(
         body, (pos, ring_idx, occ), keys)
     bb_cost, _ = net_bb_cost(pp, pos)
-    td_cost = net_td_cost(pp, pos, crit)
+    td_cost = net_td_cost(pp, pos, crit) if timing else jnp.float32(0.0)
     return pos, ring_idx, occ, na.sum(), nv.sum(), bb_cost, td_cost
 
 
@@ -528,7 +527,8 @@ class Placer:
         inv_bb, inv_td = norms()
         _, _, _, _, nv, dsum, dsq = sa_step(
             pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
-            jnp.float32(1e30), jnp.float32(max(pp.nx, pp.ny)), M)
+            jnp.float32(1e30), jnp.float32(max(pp.nx, pp.ny)), M,
+            self.timing is not None)
         nv = max(1, int(nv))
         var = float(dsq) / nv - (float(dsum) / nv) ** 2
         t = 20.0 * math.sqrt(max(var, 1e-12))
@@ -543,7 +543,8 @@ class Placer:
             key, k = jax.random.split(key)
             pos, ring, occ, na, nv, bbc, tdc = sa_temperature(
                 pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
-                jnp.float32(t), jnp.float32(rlim), M, steps)
+                jnp.float32(t), jnp.float32(rlim), M, steps,
+                self.timing is not None)
             na, nv = int(na), int(nv)
             bb_cost, td_cost = float(bbc), float(tdc)
             srat = na / max(1, nv)
@@ -569,7 +570,8 @@ class Placer:
         inv_bb, inv_td = norms()
         pos, ring, occ, _, _, bbc, tdc = sa_temperature(
             pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
-            jnp.float32(0.0), jnp.float32(1.0), M, steps)
+            jnp.float32(0.0), jnp.float32(1.0), M, steps,
+            self.timing is not None)
         stats.final_cost = float(bbc)
         stats.final_td_cost = float(tdc)
         if self.timing is not None:

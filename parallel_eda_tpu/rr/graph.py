@@ -288,37 +288,75 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                             else:
                                 add_edge(int(wire), node, arch.ipin_switch)
 
-    # ---- switch-box edges (subset pattern, endpoint rule) ----
+    # ---- switch-box edges (endpoint rule; rotation pattern on turns) ----
+    # Straight continuations keep the track index (subset rule); TURNS
+    # rotate it by a corner-parity-dependent amount:
+    #   CHANX t <-> CHANY (t + 1 + (x+y) mod 2) mod W.
+    # A pure subset box (rr_graph_sbox.c get_subset_sbox) never mixes
+    # indices, so a pin whose Fc track-set misses the target pin's set is
+    # simply unreachable (real case: two bottom-edge IO pads with disjoint
+    # 2-3 track sets).  A UNIFORM rotation is not enough either: any
+    # CHANX->...->CHANX path makes equally many X->Y and Y->X turns, so a
+    # constant shift cancels.  Two ingredients give real mixing:
+    #   1. turns connect at EVERY corner a wire passes (VPR <sb> pattern
+    #      "1 1 ... 1" semantics), not just wire endpoints — straight
+    #      continuations still happen only where one wire ends;
+    #   2. the turn shift varies with corner parity, so entering and
+    #      leaving a wire at different-parity corners nets an index
+    #      change of +-1 (the Wilton property that matters: turns permute
+    #      indices so the reachable set grows, rr_graph_sbox.c
+    #      get_wilton_sbox motivation) with O(1) bookkeeping.
     # corner (x, y): x in 0..nx, y in 0..ny
+    def ends_at(w: int, x: int, y: int) -> bool:
+        if node_type[w] == CHANX:
+            return xhigh[w] == x or xlow[w] == x + 1
+        return yhigh[w] == y or ylow[w] == y + 1
+
     for x in range(nx + 1):
         for y in range(ny + 1):
             for t in range(W):
                 sw = arch.segments[seg_of_track[t]].wire_switch
-                hx: List[int] = []   # incident CHANX wires (unique)
-                for px in (x, x + 1):
-                    if 1 <= px <= nx:
-                        w = int(chanx_wire[y][t, px])
-                        if w >= 0 and w not in hx:
-                            hx.append(w)
-                vy: List[int] = []
-                for py in (y, y + 1):
-                    if 1 <= py <= ny:
-                        w = int(chany_wire[x][t, py])
-                        if w >= 0 and w not in vy:
-                            vy.append(w)
 
-                def ends_here(w: int) -> bool:
-                    if node_type[w] == CHANX:
-                        return xhigh[w] == x or xlow[w] == x + 1
-                    return yhigh[w] == y or ylow[w] == y + 1
+                def chanx_at(tt):
+                    out: List[int] = []
+                    for px in (x, x + 1):
+                        if 1 <= px <= nx:
+                            w = int(chanx_wire[y][tt, px])
+                            if w >= 0 and w not in out:
+                                out.append(w)
+                    return out
 
-                incident = hx + vy
-                for i in range(len(incident)):
-                    for j in range(i + 1, len(incident)):
-                        a, b = incident[i], incident[j]
-                        if ends_here(a) or ends_here(b):
+                def chany_at(tt):
+                    out: List[int] = []
+                    for py in (y, y + 1):
+                        if 1 <= py <= ny:
+                            w = int(chany_wire[x][tt, py])
+                            if w >= 0 and w not in out:
+                                out.append(w)
+                    return out
+
+                hx = chanx_at(t)
+                vy = chany_at(t)
+                vy_turn = chany_at((t + 1 + (x + y) % 2) % W)
+
+                # straight continuations (same index, endpoint-gated)
+                for i in range(len(hx)):
+                    for j in range(i + 1, len(hx)):
+                        a, b = hx[i], hx[j]
+                        if ends_at(a, x, y) or ends_at(b, x, y):
                             add_edge(a, b, sw)
                             add_edge(b, a, sw)
+                for i in range(len(vy)):
+                    for j in range(i + 1, len(vy)):
+                        a, b = vy[i], vy[j]
+                        if ends_at(a, x, y) or ends_at(b, x, y):
+                            add_edge(a, b, sw)
+                            add_edge(b, a, sw)
+                # turns (rotated index, at every corner along the wires)
+                for a in hx:
+                    for b in vy_turn:
+                        add_edge(a, b, sw)
+                        add_edge(b, a, sw)
 
     # ---- pack CSR ----
     E = len(e_src)
