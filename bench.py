@@ -16,9 +16,38 @@ work (iteration 1: every net routed once).
 
 import argparse
 import json
+import sys
 import time
 
 import numpy as np
+
+
+def init_backend(retries: int = 4, delay_s: float = 10.0) -> str:
+    """Initialize the JAX backend defensively.
+
+    The tunneled single-chip TPU backend ("axon") can be transiently
+    UNAVAILABLE (chip held by another process, tunnel not up).  Retry with
+    backoff; if it never comes up, fall back to CPU so the bench still
+    emits its JSON line (detail.platform records what actually ran)."""
+    import jax
+
+    last = None
+    for attempt in range(retries):
+        try:
+            devs = jax.devices()
+            return devs[0].platform
+        except Exception as e:  # backend init failure is a RuntimeError
+            last = e
+            print(f"bench: backend init failed (attempt {attempt + 1}/"
+                  f"{retries}): {e}", file=sys.stderr)
+            time.sleep(delay_s * (attempt + 1))
+    print(f"bench: falling back to CPU after {retries} failures: {last}",
+          file=sys.stderr)
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices()[0].platform
 
 
 def build(num_luts=200, chan_width=16, seed=11):
@@ -38,6 +67,7 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     args = ap.parse_args()
 
+    platform = init_backend()
     rr, term = build(num_luts=args.luts, chan_width=args.chan_width)
 
     # warmup: a full route populates the compile cache for every wave
@@ -73,9 +103,11 @@ def main():
         "unit": "nets/s",
         "vs_baseline": round(float(speedup), 2),
         "detail": {
+            "platform": platform,
             "routed": bool(res.success),
             "iterations": int(res.iterations),
             "total_net_routes": int(res.total_net_routes),
+            "total_relax_steps": int(res.total_relax_steps),
             "route_time_s": round(dt, 3),
             "serial_nets_per_sec": round(float(serial_nets_per_sec), 2),
             "wirelength": int(res.wirelength),

@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=64,
                    help="nets routed concurrently (replaces --num_threads)")
     p.add_argument("--sink_group", type=int, default=1)
+    p.add_argument("--mesh", default="",
+                   help="multi-chip route mesh 'NETxNODE' (e.g. 4x2): "
+                   "shards nets over NET devices and the rr-graph/"
+                   "congestion over NODE devices (replaces mpirun -np N)")
+    p.add_argument("--stats_dir", default="",
+                   help="write per-run iter_stats.txt / final_stats.txt "
+                   "here (the reference's <circuit>_stats_N/ files)")
     p.add_argument("--no_timing", action="store_true",
                    help="congestion-driven only (NO_TIMING algorithm)")
     # placer opts
@@ -135,18 +142,27 @@ def main(argv=None) -> int:
               f"{flow.times['place']:.2f}s{extra})")
 
     if args.route:
+        mesh = None
+        if args.mesh:
+            from .parallel.shard import make_mesh
+            net_ax, node_ax = (int(v) for v in args.mesh.lower().split("x"))
+            mesh = make_mesh(net_ax * node_ax, shape=(net_ax, node_ax))
+            print(f"route mesh: {net_ax} net x {node_ax} node devices")
         ropts = RouterOpts(
             max_router_iterations=args.max_router_iterations,
             initial_pres_fac=args.initial_pres_fac,
             pres_fac_mult=args.pres_fac_mult,
             acc_fac=args.acc_fac, bb_factor=args.bb_factor,
-            batch_size=args.batch_size, sink_group=args.sink_group)
+            batch_size=args.batch_size, sink_group=args.sink_group,
+            stats_dir=args.stats_dir or None)
         if args.binary_search:
             wmin = binary_search_route(flow, ropts,
-                                       timing_driven=not args.no_timing)
+                                       timing_driven=not args.no_timing,
+                                       mesh=mesh)
             print(f"binary search: W_min = {wmin}")
         else:
-            run_route(flow, ropts, timing_driven=not args.no_timing)
+            run_route(flow, ropts, timing_driven=not args.no_timing,
+                      mesh=mesh)
         r = flow.route
         if not r.success:
             print(f"ROUTING FAILED after {r.iterations} iterations "

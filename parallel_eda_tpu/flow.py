@@ -204,7 +204,7 @@ def save_artifacts(flow: FlowResult, out_dir: str,
 def binary_search_route(flow: FlowResult,
                         opts: Optional[RouterOpts] = None,
                         timing_driven: bool = True,
-                        max_width: int = 0) -> int:
+                        max_width: int = 0, mesh=None) -> int:
     """Find the minimum routable channel width W_min (the reference's
     binary_search_place_and_route, base/place_and_route.c:432): starting
     from the flow's current width, halve while routable / double while
@@ -219,7 +219,8 @@ def binary_search_route(flow: FlowResult,
                                   bb_factor=flow.bb_factor)
         flow.tg = None          # routed-delay indices depend on term
         flow.analyzer = None
-        run_route(flow, opts, timing_driven=timing_driven, verify=False)
+        run_route(flow, opts, timing_driven=timing_driven, verify=False,
+                  mesh=mesh)
         last_w[0] = w
         return flow.route.success
 
@@ -257,16 +258,19 @@ def binary_search_route(flow: FlowResult,
 
 
 def run_route(flow: FlowResult, opts: Optional[RouterOpts] = None,
-              timing_driven: bool = True, verify: bool = True
-              ) -> FlowResult:
+              timing_driven: bool = True, verify: bool = True,
+              mesh=None) -> FlowResult:
     """Route + STA loop + legality oracle (try_route_new semantics,
-    route/route_common.c:298; check_route place_and_route.c:169)."""
+    route/route_common.c:298; check_route place_and_route.c:169).
+
+    ``mesh``: optional (net, node) jax.sharding.Mesh — runs the same
+    negotiation loop sharded over the devices (parallel.shard)."""
     if timing_driven:
         if flow.tg is None:
             flow.tg = build_timing_graph(flow.nl, flow.pnl, flow.term)
         if flow.analyzer is None:
             flow.analyzer = TimingAnalyzer(flow.tg)
-    router = Router(flow.rr, opts)
+    router = Router(flow.rr, opts, mesh=mesh)
     t0 = time.time()
     cb = flow.analyzer.timing_cb if timing_driven else None
     flow.route = router.route(flow.term, timing_cb=cb)

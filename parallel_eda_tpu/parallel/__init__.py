@@ -1,1 +1,1 @@
-from .shard import (ShardedRouter, make_mesh, route_step_sharded)
+from .shard import ShardedRouter, make_mesh, shard_graph

@@ -19,24 +19,26 @@ TPU-native replacement for the reference's entire distributed stack
   pick a mesh, annotate, let the compiler place collectives; a hand-tuned
   ppermute halo-exchange pallas kernel is a later optimization).
 
-Determinism is inherent: fixed mesh, fixed reduction order.  The
-communicator-halving machinery (MPI_Comm_split on plateau) collapses into
-re-jitting with a smaller mesh if ever needed.
+The full negotiation loop runs sharded: ``route.Router(rr, opts, mesh=m)``
+keeps occ/acc on the mesh across iterations and dispatches the fused
+rip-up/route/commit step (search.route_and_commit) per batch — the
+reference's complete iterating MPI router (load rebalance, plateau
+shrink) maps to the Router's existing schedule + re-jit on a smaller
+mesh.  Determinism is inherent: fixed mesh, fixed reduction order, and
+every cross-shard reduction is an integer sum or an elementwise min —
+sharded results are bit-identical to single-device (tested).
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..route.device_graph import DeviceRRGraph
-from ..route.search import (congestion_cost, route_net_batch,
-                            usage_from_paths)
+from ..route.search import route_and_commit
 
 NET, NODE = "net", "node"
 
@@ -56,60 +58,40 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.array(devs).reshape(shape), (NET, NODE))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("max_steps", "max_len", "num_waves", "group"))
-def _route_and_commit(dev: DeviceRRGraph, occ, acc, pres_fac,
-                      prev_paths, source, sinks, bb, crit, net_key, valid,
-                      max_steps: int, max_len: int, num_waves: int,
-                      group: int):
-    """One sharded route step: rip up the batch's previous paths, route
-    every net against the resulting occupancy view, commit the new
-    occupancy.  [B, ...] inputs are sharded over "net"; [.., N] arrays
-    over "node"; the cross-shard sums become psums."""
-    N = dev.num_nodes
-    nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
-    old_usage = usage_from_paths(prev_paths, nodes_p1)
-    old_usage = old_usage & valid[:, None]
-    occ_rip = occ - jnp.sum(old_usage, axis=0, dtype=jnp.int32)   # psum
-    # each net sees everyone else's occupancy: global minus its own usage
-    # (serial rip-up-one-net view, route_timing.c:399 semantics)
-    occ_view = occ[None, :] - old_usage.astype(jnp.int32)
-
-    cong = congestion_cost(dev, occ_view, acc, pres_fac)
-    paths, reached, delay, usage = route_net_batch(
-        dev, cong, source, sinks, bb, crit, net_key,
-        max_steps, max_len, num_waves, group)
-    usage = usage & valid[:, None]
-    occ_new = occ_rip + jnp.sum(usage, axis=0, dtype=jnp.int32)   # psum
-    return paths, reached, delay, occ_new
+def shard_graph(dev: DeviceRRGraph, mesh: Mesh) -> DeviceRRGraph:
+    """Place the rr-graph on the mesh: ELL tables + node properties are
+    sharded over the "node" axis (the rr_graph_partitioner.h:840 spatial
+    partition, minus the boundary-node bookkeeping GSPMD makes moot)."""
+    s_node = NamedSharding(mesh, P(NODE))
+    s_node_ell = NamedSharding(mesh, P(NODE, None))
+    put = jax.device_put
+    return DeviceRRGraph(
+        ell_src=put(dev.ell_src, s_node_ell),
+        ell_delay=put(dev.ell_delay, s_node_ell),
+        ell_valid=put(dev.ell_valid, s_node_ell),
+        cong_base=put(dev.cong_base, s_node),
+        capacity=put(dev.capacity, s_node),
+        xlow=put(dev.xlow, s_node),
+        xhigh=put(dev.xhigh, s_node),
+        ylow=put(dev.ylow, s_node),
+        yhigh=put(dev.yhigh, s_node),
+        is_wire=put(dev.is_wire, s_node),
+    )
 
 
 class ShardedRouter:
-    """Binds a (net, node) mesh to the route step via input shardings;
-    GSPMD propagates them through the jitted program."""
+    """Binds a (net, node) mesh to the fused route step via input
+    shardings; GSPMD propagates them through the jitted program.  For the
+    complete negotiation loop use route.Router(..., mesh=mesh), which
+    shares the same step."""
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
         self.s_batch = NamedSharding(mesh, P(NET))          # [B, ...]
         self.s_node = NamedSharding(mesh, P(NODE))          # [N]
-        self.s_node_ell = NamedSharding(mesh, P(NODE, None))  # [N, D]
 
     def shard_graph(self, dev: DeviceRRGraph) -> DeviceRRGraph:
-        """Place the rr-graph: ELL tables + node properties over "node"."""
-        put = jax.device_put
-        return DeviceRRGraph(
-            ell_src=put(dev.ell_src, self.s_node_ell),
-            ell_delay=put(dev.ell_delay, self.s_node_ell),
-            ell_valid=put(dev.ell_valid, self.s_node_ell),
-            cong_base=put(dev.cong_base, self.s_node),
-            capacity=put(dev.capacity, self.s_node),
-            xlow=put(dev.xlow, self.s_node),
-            xhigh=put(dev.xhigh, self.s_node),
-            ylow=put(dev.ylow, self.s_node),
-            yhigh=put(dev.yhigh, self.s_node),
-            is_wire=put(dev.is_wire, self.s_node),
-        )
+        return shard_graph(dev, self.mesh)
 
     def route_step(self, dev: DeviceRRGraph, occ, acc, pres_fac,
                    prev_paths, source, sinks, bb, crit, net_key, valid,
@@ -131,17 +113,6 @@ class ShardedRouter:
         valid = put(valid, self.s_batch)
         occ = put(occ, self.s_node)
         acc = put(acc, self.s_node)
-        return _route_and_commit(
+        return route_and_commit(
             dev, occ, acc, pres_fac, prev_paths, source, sinks, bb, crit,
             net_key, valid, max_steps, max_len, num_waves, group)
-
-
-def route_step_sharded(mesh: Mesh, dev: DeviceRRGraph, occ, acc, pres_fac,
-                       prev_paths, source, sinks, bb, crit, net_key, valid,
-                       max_steps: int, max_len: int, num_waves: int,
-                       group: int = 1):
-    """Functional convenience wrapper around ShardedRouter.route_step."""
-    r = ShardedRouter(mesh)
-    return r.route_step(
-        r.shard_graph(dev), occ, acc, pres_fac, prev_paths, source, sinks,
-        bb, crit, net_key, valid, max_steps, max_len, num_waves, group)

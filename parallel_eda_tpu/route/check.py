@@ -31,16 +31,20 @@ class RouteError(AssertionError):
     pass
 
 
+def _edge_key_set(rr: RRGraph) -> set:
+    """Edge set for O(1) membership: key = src * N + dst."""
+    N = rr.num_nodes
+    src_ids = np.repeat(np.arange(N, dtype=np.int64), np.diff(rr.out_row_ptr))
+    return set((src_ids * N + rr.out_dst).tolist())
+
+
 def check_route(rr: RRGraph, term: NetTerminals, paths: np.ndarray,
                 occ: Optional[np.ndarray] = None) -> dict:
     """paths [R, Smax, L] int32 (sentinel == num_nodes).  Raises RouteError
     on any violation; returns stats dict."""
     N = rr.num_nodes
     R, Smax, L = paths.shape
-
-    # edge set for O(1) membership: key = src * N + dst
-    src_ids = np.repeat(np.arange(N, dtype=np.int64), np.diff(rr.out_row_ptr))
-    edge_keys = set((src_ids * N + rr.out_dst).tolist())
+    edge_keys = _edge_key_set(rr)
 
     recomputed_occ = np.zeros(N, dtype=np.int64)
     total_wire = 0
@@ -127,5 +131,58 @@ def check_route(rr: RRGraph, term: NetTerminals, paths: np.ndarray,
                 f"(recomputed {recomputed_occ[bad].tolist()} vs "
                 f"router {np.asarray(occ)[bad].tolist()})")
 
+    return {"wirelength": total_wire,
+            "max_occ": int(recomputed_occ.max(initial=0))}
+
+
+def check_route_trees(rr: RRGraph, term: NetTerminals, trees,
+                      occ: Optional[np.ndarray] = None) -> dict:
+    """Same oracle for tree-form routings: trees[r] = [(node, parent),...]
+    in tree order, SOURCE first with parent -1 (the .route-file payload
+    and the serial reference router's output)."""
+    N = rr.num_nodes
+    R = term.source.shape[0]
+    if len(trees) != R:
+        raise RouteError(f"{len(trees)} trees for {R} nets")
+    edge_keys = _edge_key_set(rr)
+    recomputed_occ = np.zeros(N, dtype=np.int64)
+    total_wire = 0
+    for r, rows in enumerate(trees):
+        source = int(term.source[r])
+        ns = int(term.num_sinks[r])
+        sink_set = set(int(x) for x in term.sinks[r, :ns])
+        if not rows or rows[0][0] != source or rows[0][1] != -1:
+            raise RouteError(f"net {r}: tree must start at its SOURCE")
+        seen = {source}
+        for node, par in rows[1:]:
+            if par not in seen:
+                raise RouteError(
+                    f"net {r}: parent {par} of {rr.describe(node)} not yet "
+                    f"in tree (rows out of order or disconnected)")
+            if node in seen:
+                raise RouteError(f"net {r}: node {node} added twice")
+            if par * N + node not in edge_keys:
+                raise RouteError(f"net {r}: no rr-edge "
+                                 f"{rr.describe(par)} -> {rr.describe(node)}")
+            seen.add(node)
+        for sk in sink_set:
+            if sk not in seen:
+                raise RouteError(
+                    f"net {r}: sink {rr.describe(sk)} not connected")
+        for v in seen:
+            t = rr.node_type[v]
+            if t == SINK and v not in sink_set:
+                raise RouteError(f"net {r} routes through foreign sink {v}")
+            if t == SOURCE and v != source:
+                raise RouteError(f"net {r} routes through foreign source {v}")
+            recomputed_occ[v] += 1
+            if t in (CHANX, CHANY):
+                total_wire += 1
+    over = recomputed_occ - np.asarray(rr.capacity, dtype=np.int64)
+    if (over > 0).any():
+        raise RouteError(f"{int((over > 0).sum())} overused nodes")
+    if occ is not None and not np.array_equal(
+            recomputed_occ, np.asarray(occ, dtype=np.int64)):
+        raise RouteError("occupancy drift vs router counts")
     return {"wirelength": total_wire,
             "max_occ": int(recomputed_occ.max(initial=0))}

@@ -37,8 +37,7 @@ import numpy as np
 from ..rr.graph import RRGraph
 from ..rr.terminals import NetTerminals
 from .device_graph import DeviceRRGraph, to_device
-from .search import (congestion_cost, occupancy_delta, route_net_batch,
-                     usage_from_paths)
+from .search import route_and_commit
 
 
 @dataclass
@@ -58,17 +57,25 @@ class RouterOpts:
     # after this iteration, rip up & reroute only illegal nets
     # (reference phase-two style refinement, …cxx:6238-6267)
     incremental_after: int = 1
+    # per-run stats directory: writes iter_stats.txt / final_stats.txt in
+    # the reference's schema (…cxx:5925-5935, 6344-6360); None = off
+    stats_dir: Optional[str] = None
 
 
 @dataclass
 class RouteStats:
     """Per-iteration stats (iter_stats.txt schema,
-    partitioning_multi_sink…cxx:5925-5931)."""
+    partitioning_multi_sink…cxx:5925-5931: route time, heap
+    pops/visits/pushes -> relax_steps, overuse count/%, crit path)."""
     iteration: int
     overused_nodes: int
     overuse_total: int
     rerouted_nets: int
     route_time_s: float
+    relax_steps: int = 0         # Bellman-Ford sweeps (heap-pops analogue)
+    batches: int = 0             # device dispatches this iteration
+    overuse_pct: float = 0.0     # overused nodes / all rr nodes
+    crit_path_delay: float = float("nan")
 
 
 @dataclass
@@ -80,8 +87,9 @@ class RouteResult:
     occ: np.ndarray              # [N] int32 final occupancy
     wirelength: int
     stats: List[RouteStats] = field(default_factory=list)
-    # search effort counter (perf_t analogue, route.h:12-20)
+    # search effort counters (perf_t analogue, route.h:12-20)
     total_net_routes: int = 0
+    total_relax_steps: int = 0
 
 
 def _color_schedule(idx: np.ndarray, paths: np.ndarray, occ: np.ndarray,
@@ -119,6 +127,40 @@ def _color_schedule(idx: np.ndarray, paths: np.ndarray, occ: np.ndarray,
             for c in range(ncolors)]
 
 
+def write_stats_files(stats_dir: str, result: "RouteResult") -> None:
+    """Emit iter_stats.txt / final_stats.txt in the reference's schema
+    (partitioning_multi_sink_delta_stepping_route.cxx:5925-5935 header +
+    :6307-6318 rows; :6344-6360 final) so runs can be diffed against the
+    reference's own output files (BASELINE.md comparison surface)."""
+    import os
+
+    os.makedirs(stats_dir, exist_ok=True)
+    with open(os.path.join(stats_dir, "iter_stats.txt"), "w") as f:
+        f.write("iteration route_time relax_steps batches rerouted_nets "
+                "overused_nodes overuse_total overuse_pct crit_path_delay\n")
+        for s in result.stats:
+            f.write(f"{s.iteration} {s.route_time_s:.6f} {s.relax_steps} "
+                    f"{s.batches} {s.rerouted_nets} {s.overused_nodes} "
+                    f"{s.overuse_total} {s.overuse_pct:.4f} "
+                    f"{s.crit_path_delay:.6e}\n")
+    with open(os.path.join(stats_dir, "final_stats.txt"), "w") as f:
+        f.write(f"routed {int(result.success)}\n")
+        f.write(f"num_iterations {result.iterations}\n")
+        f.write(f"total_route_time "
+                f"{sum(s.route_time_s for s in result.stats):.6f}\n")
+        f.write(f"total_relax_steps {result.total_relax_steps}\n")
+        f.write(f"total_net_routes {result.total_net_routes}\n")
+        f.write(f"wirelength {result.wirelength}\n")
+        # the converged iteration breaks out before its timing callback,
+        # so report the last stamped crit-path value
+        cpd = float("nan")
+        for s in reversed(result.stats):
+            if s.crit_path_delay == s.crit_path_delay:
+                cpd = s.crit_path_delay
+                break
+        f.write(f"final_crit_path_delay {cpd:.6e}\n")
+
+
 def _pad_to(a: np.ndarray, B: int, fill) -> np.ndarray:
     n = a.shape[0]
     if n == B:
@@ -133,15 +175,49 @@ def _pow2_at_least(x: int) -> int:
 
 class Router:
     """Holds device state across a route() call; reusable across calls
-    (e.g. the placer's delay-lookup routing, timing_place_lookup.c:981)."""
+    (e.g. the placer's delay-lookup routing, timing_place_lookup.c:981).
 
-    def __init__(self, rr: RRGraph, opts: Optional[RouterOpts] = None):
+    Pass ``mesh`` (a 2-D jax.sharding.Mesh with axes ("net", "node")) to
+    run the SAME negotiation loop multi-chip: the rr-graph/congestion
+    arrays are sharded over rr-nodes, each batch of nets over the net
+    axis, and the occupancy commit becomes a psum over ICI — the
+    reference's MPI net-partitioned router with async congestion
+    broadcast (mpi_route_load_balanced_nonblocking_send_recv_encoded.cxx)
+    collapsed into GSPMD sharding annotations.  Results are bit-identical
+    to the single-device run: every cross-shard reduction is an integer
+    occupancy sum or an elementwise min with fixed order."""
+
+    def __init__(self, rr: RRGraph, opts: Optional[RouterOpts] = None,
+                 mesh=None):
         self.rr = rr
         self.opts = opts or RouterOpts()
         self.dev: DeviceRRGraph = to_device(rr)
         nx, ny = rr.grid.nx, rr.grid.ny
         # path-length / BF-step bound: a bb-confined path can wind, give slack
         self.max_len = 4 * (nx + ny) + 64
+        self.mesh = mesh
+        self._s_batch = self._s_node = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.shard import NET, NODE, shard_graph
+            self.dev = shard_graph(self.dev, mesh)
+            self._s_batch = NamedSharding(mesh, P(NET))
+            self._s_node = NamedSharding(mesh, P(NODE))
+            self._net_axis = mesh.shape[NET]
+
+    def _put_batch(self, a: np.ndarray):
+        import jax
+        x = jnp.asarray(a)
+        if self._s_batch is not None:
+            x = jax.device_put(x, self._s_batch)
+        return x
+
+    def _put_node(self, x):
+        import jax
+        if self._s_node is not None:
+            x = jax.device_put(x, self._s_node)
+        return x
 
     def route(self, term: NetTerminals,
               crit: Optional[np.ndarray] = None,
@@ -157,6 +233,9 @@ class Router:
         R, Smax = term.sinks.shape
         N = rr.num_nodes
         B = min(opts.batch_size, max(1, R))
+        if self.mesh is not None and B % self._net_axis:
+            # batch must tile the net axis evenly
+            B = ((B + self._net_axis - 1) // self._net_axis) * self._net_axis
 
         if crit is None:
             crit = np.zeros((R, Smax), dtype=np.float32)
@@ -165,10 +244,9 @@ class Router:
             # exactly 1 zeroes the congestion term and kills negotiation
             crit = np.minimum(np.asarray(crit, dtype=np.float32), 0.99)
 
-        occ = jnp.zeros(N, dtype=jnp.int32)
-        acc = jnp.ones(N, dtype=jnp.float32)
+        occ = self._put_node(jnp.zeros(N, dtype=jnp.int32))
+        acc = self._put_node(jnp.ones(N, dtype=jnp.float32))
         cap_np = np.asarray(rr.capacity, dtype=np.int64)
-        nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
 
         paths = np.full((R, Smax, self.max_len), N, dtype=np.int32)
         sink_delay = np.full((R, Smax), np.inf, dtype=np.float32)
@@ -188,6 +266,7 @@ class Router:
 
         for it in range(1, opts.max_router_iterations + 1):
             t0 = time.time()
+            it_steps = 0
             occ_np = np.asarray(occ)
             if it <= opts.incremental_after:
                 reroute = np.ones(R, dtype=bool)
@@ -213,31 +292,25 @@ class Router:
                 nsel = len(sel)
                 b_valid = np.zeros(B, dtype=bool)
                 b_valid[:nsel] = True
-                b_valid_j = jnp.asarray(b_valid)
                 b_paths = _pad_to(paths[sel], B, N)
 
-                # rip up this batch's previous usage from the running occ,
-                # but cost each net against the occupancy of *everyone
-                # else* (including batch peers' previous paths) — the
-                # serial rip-up-one-net-at-a-time view, route_timing.c:399
-                old_usage = usage_from_paths(jnp.asarray(b_paths), nodes_p1)
-                occ_view = occ[None, :] - old_usage.astype(jnp.int32)
-                occ = occ - occupancy_delta(old_usage, b_valid_j)
-
-                cong = congestion_cost(dev, occ_view, acc,
-                                       jnp.float32(pres_fac))
                 max_ns = int(nsinks_np[sel].max())
                 waves = _pow2_at_least(
                     max(1, math.ceil(max_ns / opts.sink_group)))
-                p, reached, delay, usage = route_net_batch(
-                    dev, cong,
-                    jnp.asarray(_pad_to(source_np[sel], B, 0)),
-                    jnp.asarray(_pad_to(sinks_np[sel], B, -1)),
-                    jnp.asarray(_pad_to(bb[sel], B, 0)),
-                    jnp.asarray(_pad_to(crit[sel], B, 0.0)),
-                    jnp.asarray(_pad_to(sel.astype(np.int32), B, 0)),
+                # fused rip-up + route + commit, one device dispatch; each
+                # net is costed against the occupancy of *everyone else*
+                # (serial rip-up-one-net-at-a-time view, route_timing.c:399)
+                p, reached, delay, occ, steps = route_and_commit(
+                    dev, occ, acc, jnp.float32(pres_fac),
+                    self._put_batch(b_paths),
+                    self._put_batch(_pad_to(source_np[sel], B, 0)),
+                    self._put_batch(_pad_to(sinks_np[sel], B, -1)),
+                    self._put_batch(_pad_to(bb[sel], B, 0)),
+                    self._put_batch(_pad_to(crit[sel], B, 0.0)),
+                    self._put_batch(_pad_to(sel.astype(np.int32), B, 0)),
+                    self._put_batch(b_valid),
                     self.max_len, self.max_len, waves, opts.sink_group)
-                occ = occ + occupancy_delta(usage, b_valid_j)
+                it_steps += int(steps)
 
                 paths[sel] = np.asarray(p[:nsel])
                 sink_delay[sel] = np.asarray(delay[:nsel])
@@ -254,16 +327,21 @@ class Router:
             occ_np = np.asarray(occ)
             over = np.maximum(0, occ_np - cap_np)
             n_over = int((over > 0).sum())
+            result.total_relax_steps += it_steps
             result.stats.append(RouteStats(
-                it, n_over, int(over.sum()), len(idx), time.time() - t0))
+                it, n_over, int(over.sum()), len(idx), time.time() - t0,
+                relax_steps=it_steps, batches=len(batches),
+                overuse_pct=100.0 * n_over / max(1, N)))
 
             if n_over == 0 and all_reached.all():
                 result.success = True
                 result.iterations = it
                 break
 
-            # pathfinder history/present update (congestion.h:177-193)
-            acc = acc + opts.acc_fac * jnp.asarray(over, dtype=jnp.float32)
+            # pathfinder history/present update (congestion.h:177-193),
+            # computed on device so sharded acc never leaves the mesh
+            acc = acc + opts.acc_fac * jnp.maximum(
+                occ - dev.capacity, 0).astype(jnp.float32)
             pres_fac = min(opts.max_pres_fac, pres_fac * opts.pres_fac_mult)
 
             if timing_cb is not None:
@@ -278,4 +356,6 @@ class Router:
         union[paths.ravel()] = True
         is_wire = np.asarray(self.dev.is_wire)
         result.wirelength = int(union[:N][is_wire].sum())
+        if opts.stats_dir:
+            write_stats_files(opts.stats_dir, result)
         return result

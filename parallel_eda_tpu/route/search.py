@@ -116,9 +116,9 @@ def _relax(dev: DeviceRRGraph, cong_c: jnp.ndarray, crit_c: jnp.ndarray,
     def cond(state):
         return state[3] & (state[4] < max_steps)
 
-    dist, prev, tdel, _, _ = lax.while_loop(
+    dist, prev, tdel, _, steps = lax.while_loop(
         cond, step, (dist0, prev0, tdel0, jnp.bool_(True), jnp.int32(0)))
-    return dist, prev, tdel
+    return dist, prev, tdel, steps
 
 
 def _traceback(prev: jnp.ndarray, seed: jnp.ndarray, sink: jnp.ndarray,
@@ -169,7 +169,10 @@ def route_net_batch(dev: DeviceRRGraph, cong: jnp.ndarray,
     for the symmetry-breaking jitter.
 
     Returns (paths [B, S, L] sentinel-N-padded sink->tree segments,
-    reached [B, S], sink_delay [B, S], usage [B, N] tree-node masks).
+    reached [B, S], sink_delay [B, S], usage [B, N] tree-node masks,
+    relax_steps scalar — total Bellman-Ford sweeps, the perf_t
+    heap-pops/neighbor-visits analogue, route.h:12-20; one sweep visits
+    every in-edge of every in-box node once).
     """
     B, S = sinks.shape
     N = dev.num_nodes
@@ -193,12 +196,15 @@ def route_net_batch(dev: DeviceRRGraph, cong: jnp.ndarray,
     delay = jnp.full((B, S), INF, jnp.float32)
     reached_all = jnp.zeros((B, S), bool)
 
+    relax_steps = jnp.int32(0)
     for _ in range(num_waves):
         # wave criticality: strongest remaining sink drives the delay weight
         crit_w = jnp.max(jnp.where(remaining, crit, 0.0), axis=1)  # [B]
         cong_c = (1.0 - crit_w)[:, None] * cong * jitter
-        dist, prev, tdel = _relax(dev, cong_c, crit_w[:, None], inside,
-                                  seed[:, :N], tdel_tree, max_steps)
+        dist, prev, tdel, steps = _relax(dev, cong_c, crit_w[:, None],
+                                         inside, seed[:, :N], tdel_tree,
+                                         max_steps)
+        relax_steps = relax_steps + steps
 
         # pick up to `group` sinks: most critical first, nearest to the
         # current tree among equals (route_timing.c sorts sinks by
@@ -236,7 +242,7 @@ def route_net_batch(dev: DeviceRRGraph, cong: jnp.ndarray,
         tdel_tree = jnp.where(newly[:, :N], tdel, tdel_tree)
         seed = seed | newly
 
-    return paths, reached_all, delay, seed[:, :N]
+    return paths, reached_all, delay, seed[:, :N], relax_steps
 
 
 def _scatter_rows(arr, idx, vals):
@@ -249,6 +255,37 @@ def _scatter_vals(arr, idx, vals):
     """arr [B, S], idx [B, G], vals [B, G]."""
     B = arr.shape[0]
     return arr.at[jnp.arange(B)[:, None], idx].set(vals)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_steps", "max_len", "num_waves", "group"))
+def route_and_commit(dev: DeviceRRGraph, occ, acc, pres_fac,
+                     prev_paths, source, sinks, bb, crit, net_key, valid,
+                     max_steps: int, max_len: int, num_waves: int,
+                     group: int):
+    """One fused batch step: rip up the batch's previous paths, route every
+    net against the occupancy view of everyone-but-itself, commit the new
+    occupancy.  Single dispatch — the whole PathFinder inner step is one
+    XLA program, so under a (net, node) mesh the cross-shard sums become
+    psums and the serial Router pays one host round-trip per batch.
+
+    Returns (paths, reached, delay, occ_new, relax_steps)."""
+    N = dev.num_nodes
+    nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
+    old_usage = usage_from_paths(prev_paths, nodes_p1)
+    old_usage = old_usage & valid[:, None]
+    occ_rip = occ - jnp.sum(old_usage, axis=0, dtype=jnp.int32)
+    # each net sees everyone else's occupancy: global minus its own usage
+    # (serial rip-up-one-net view, route_timing.c:399 semantics)
+    occ_view = occ[None, :] - old_usage.astype(jnp.int32)
+
+    cong = congestion_cost(dev, occ_view, acc, pres_fac)
+    paths, reached, delay, usage, relax_steps = route_net_batch(
+        dev, cong, source, sinks, bb, crit, net_key,
+        max_steps, max_len, num_waves, group)
+    usage = usage & valid[:, None]
+    occ_new = occ_rip + jnp.sum(usage, axis=0, dtype=jnp.int32)
+    return paths, reached, delay, occ_new, relax_steps
 
 
 @jax.jit

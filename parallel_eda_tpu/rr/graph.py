@@ -288,24 +288,24 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                             else:
                                 add_edge(int(wire), node, arch.ipin_switch)
 
-    # ---- switch-box edges (endpoint rule; rotation pattern on turns) ----
-    # Straight continuations keep the track index (subset rule); TURNS
-    # rotate it by a corner-parity-dependent amount:
-    #   CHANX t <-> CHANY (t + 1 + (x+y) mod 2) mod W.
-    # A pure subset box (rr_graph_sbox.c get_subset_sbox) never mixes
+    # ---- switch-box edges (endpoint rule; subset + rotated mixing) ----
+    # Straight continuations and same-index turns follow the subset rule
+    # (rr_graph_sbox.c get_subset_sbox: track t only meets track t), which
+    # converges fast under PathFinder because the per-track subnetworks are
+    # interchangeable.  A pure subset box, however, never mixes track
     # indices, so a pin whose Fc track-set misses the target pin's set is
     # simply unreachable (real case: two bottom-edge IO pads with disjoint
-    # 2-3 track sets).  A UNIFORM rotation is not enough either: any
-    # CHANX->...->CHANX path makes equally many X->Y and Y->X turns, so a
-    # constant shift cancels.  Two ingredients give real mixing:
-    #   1. turns connect at EVERY corner a wire passes (VPR <sb> pattern
-    #      "1 1 ... 1" semantics), not just wire endpoints — straight
-    #      continuations still happen only where one wire ends;
-    #   2. the turn shift varies with corner parity, so entering and
-    #      leaving a wire at different-parity corners nets an index
-    #      change of +-1 (the Wilton property that matters: turns permute
-    #      indices so the reachable set grows, rr_graph_sbox.c
-    #      get_wilton_sbox motivation) with O(1) bookkeeping.
+    # 2-3 track sets).  We therefore ADD endpoint-gated turns at a rotated
+    # index, CHANX t <-> CHANY (t + 1 + (x+y) mod 2) mod W: the shift
+    # varies with corner parity so an X->Y->X loop nets an index change of
+    # +-1 (the Wilton property that matters — turns permute indices so the
+    # reachable track set grows, rr_graph_sbox.c get_wilton_sbox
+    # motivation) while every edge still obeys the endpoint rule, keeping
+    # the switch count O(W) per corner like the reference's Fs=3 boxes.
+    # (A previous variant put rotated turns at EVERY corner a wire passes
+    # and dropped same-index turns entirely; it stayed connected but made
+    # congestion negotiation ~2-3x slower to converge — per-track
+    # interchangeability is what lets PathFinder shift a net sideways.)
     # corner (x, y): x in 0..nx, y in 0..ny
     def ends_at(w: int, x: int, y: int) -> bool:
         if node_type[w] == CHANX:
@@ -352,11 +352,21 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                         if ends_at(a, x, y) or ends_at(b, x, y):
                             add_edge(a, b, sw)
                             add_edge(b, a, sw)
-                # turns (rotated index, at every corner along the wires)
+                # same-index turns (subset rule, endpoint-gated)
                 for a in hx:
-                    for b in vy_turn:
-                        add_edge(a, b, sw)
-                        add_edge(b, a, sw)
+                    for b in vy:
+                        if ends_at(a, x, y) or ends_at(b, x, y):
+                            add_edge(a, b, sw)
+                            add_edge(b, a, sw)
+                # rotated turns (index mixing, endpoint-gated); at W <= 2
+                # the rotated track can coincide with t — skip to avoid
+                # duplicating the same-index turns above
+                if (t + 1 + (x + y) % 2) % W != t:
+                    for a in hx:
+                        for b in vy_turn:
+                            if ends_at(a, x, y) or ends_at(b, x, y):
+                                add_edge(a, b, sw)
+                                add_edge(b, a, sw)
 
     # ---- pack CSR ----
     E = len(e_src)

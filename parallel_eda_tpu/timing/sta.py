@@ -125,5 +125,10 @@ class TimingAnalyzer:
         return np.asarray(crit).reshape(R, Smax)
 
     def timing_cb(self, result) -> np.ndarray:
-        """Router timing_cb hook (router.py Router.route)."""
-        return self.analyze(result.sink_delay)
+        """Router timing_cb hook (router.py Router.route); stamps the
+        iteration's crit-path delay into its stats row (the analyze_timing
+        -> iter_stats crit_path column, …cxx:6302-6318)."""
+        crit = self.analyze(result.sink_delay)
+        if result.stats:
+            result.stats[-1].crit_path_delay = self.crit_path_delay
+        return crit

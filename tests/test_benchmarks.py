@@ -1,0 +1,61 @@
+"""Structured-benchmark synthesis + serial CPU baseline router tests.
+
+The serial router doubles as an independent oracle for the TPU router:
+both must legally route the same real-logic circuit (SURVEY §4
+determinism-as-oracle adapted: two independent implementations agree on
+feasibility and quality class)."""
+
+import numpy as np
+
+from parallel_eda_tpu.arch.builtin import minimal_arch
+from parallel_eda_tpu.flow import prepare
+from parallel_eda_tpu.netlist.blif import parse_blif, write_blif
+from parallel_eda_tpu.netlist.synthesis import array_multiplier, crc_xor_tree
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
+from parallel_eda_tpu.route.check import check_route_trees
+from parallel_eda_tpu.route.serial_ref import SerialRouter
+
+
+def test_synthesis_netlists_wellformed():
+    m = array_multiplier(6)
+    assert m.num_luts > 50
+    assert m.num_ffs == 2 * 6 + 12        # input regs + product regs
+    c = crc_xor_tree(16, 16, K=4)
+    assert c.num_luts > 30
+    assert c.num_ffs == 16
+
+
+def test_synthesis_blif_roundtrip(tmp_path):
+    m = array_multiplier(6)
+    p = str(tmp_path / "mult6.blif")
+    write_blif(m, p)
+    with open(p) as f:
+        back = parse_blif(f.read(), K=4)
+    assert back.num_luts == m.num_luts
+    assert back.num_ffs == m.num_ffs
+    assert set(back.net_driver) == set(m.net_driver)
+
+
+def test_serial_router_legal_on_multiplier():
+    nl = array_multiplier(6)
+    arch = minimal_arch(chan_width=14)
+    f = prepare(nl, arch, 14)
+    sr = SerialRouter(f.rr, max_iterations=40)
+    res = sr.route(f.term)
+    assert res.success, f"serial router failed: {res.stats[-1]}"
+    stats = check_route_trees(f.rr, f.term, res.trees, occ=res.occ)
+    assert stats["wirelength"] == res.wirelength
+    assert res.heap_pops > 0
+
+
+def test_serial_and_tpu_router_agree_on_quality():
+    nl = array_multiplier(6)
+    arch = minimal_arch(chan_width=14)
+    f = prepare(nl, arch, 14)
+    sr = SerialRouter(f.rr, max_iterations=40).route(f.term)
+    tr = Router(f.rr, RouterOpts(batch_size=32)).route(f.term)
+    assert sr.success and tr.success
+    check_route(f.rr, f.term, tr.paths, occ=tr.occ)
+    # same quality class: wirelengths within 25% of each other
+    assert tr.wirelength < sr.wirelength * 1.25
+    assert sr.wirelength < tr.wirelength * 1.25

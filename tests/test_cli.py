@@ -75,15 +75,18 @@ def test_route_file_roundtrip(tmp_path):
 
 
 def test_binary_search_wmin():
-    f = synth_flow(num_luts=30, chan_width=12, seed=4)
-    wmin = binary_search_route(f, RouterOpts(batch_size=32),
-                               timing_driven=False)
+    f = synth_flow(num_luts=20, chan_width=12, seed=4)
+    # short iteration cap: failed widths burn max_router_iterations
+    wmin = binary_search_route(
+        f, RouterOpts(batch_size=16, max_router_iterations=25),
+        timing_driven=False)
     assert f.route.success
     assert f.rr.chan_width == wmin
     assert wmin >= 1
     # minimality: one track less must fail
     if wmin > 1:
-        f2 = synth_flow(num_luts=30, chan_width=wmin - 1, seed=4)
-        f2 = run_route(f2, RouterOpts(batch_size=32), timing_driven=False,
-                       verify=False)
+        f2 = synth_flow(num_luts=20, chan_width=wmin - 1, seed=4)
+        f2 = run_route(f2,
+                       RouterOpts(batch_size=16, max_router_iterations=25),
+                       timing_driven=False, verify=False)
         assert not f2.route.success

@@ -8,9 +8,9 @@ from parallel_eda_tpu.route import RouterOpts
 
 
 def test_full_flow_place_route_sta():
-    f = synth_flow(num_luts=30, chan_width=12, seed=1)
+    f = synth_flow(num_luts=25, chan_width=12, seed=1)
     f = run_place(f, PlacerOpts(moves_per_step=32, seed=1))
-    f = run_route(f, RouterOpts(batch_size=32))
+    f = run_route(f, RouterOpts(batch_size=16))
     assert f.route.success
     assert np.isfinite(f.crit_path_delay) and f.crit_path_delay > 0
     assert f.place_stats.final_cost <= f.place_stats.initial_cost
@@ -20,12 +20,13 @@ def test_full_flow_place_route_sta():
 def test_flow_placement_improves_routing():
     # SA placement should not hurt routed wirelength vs the random initial
     # placement (on average it helps a lot; allow slack for small cases)
-    f0 = synth_flow(num_luts=40, chan_width=14, seed=5)
-    f0 = run_route(f0, RouterOpts(batch_size=32), timing_driven=False)
+    f0 = synth_flow(num_luts=25, chan_width=12, seed=5)
+    f0 = run_route(f0, RouterOpts(batch_size=16), timing_driven=False)
     wl_initial = f0.route.wirelength
 
-    f1 = synth_flow(num_luts=40, chan_width=14, seed=5)
-    f1 = run_place(f1, PlacerOpts(moves_per_step=64, seed=0))
-    f1 = run_route(f1, RouterOpts(batch_size=32), timing_driven=False)
+    f1 = synth_flow(num_luts=25, chan_width=12, seed=5)
+    f1 = run_place(f1, PlacerOpts(moves_per_step=32, seed=0),
+                   timing_driven=False)
+    f1 = run_route(f1, RouterOpts(batch_size=16), timing_driven=False)
     assert f1.route.success
     assert f1.route.wirelength < wl_initial * 1.05

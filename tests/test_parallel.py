@@ -8,9 +8,10 @@ import numpy as np
 import pytest
 
 from parallel_eda_tpu.flow import synth_flow
-from parallel_eda_tpu.parallel.shard import (ShardedRouter,
-                                             _route_and_commit, make_mesh)
+from parallel_eda_tpu.parallel.shard import ShardedRouter, make_mesh
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
 from parallel_eda_tpu.route.device_graph import to_device
+from parallel_eda_tpu.route.search import route_and_commit
 
 
 def _setup(B=8):
@@ -46,7 +47,7 @@ def _setup(B=8):
 def _run(dev, a, mesh=None):
     kw = dict(max_steps=96, max_len=96, num_waves=2, group=1)
     if mesh is None:
-        return _route_and_commit(
+        return route_and_commit(
             dev, a["occ"], a["acc"], jnp.float32(0.5), a["prev_paths"],
             a["source"], a["sinks"], a["bb"], a["crit"], a["net_key"],
             a["valid"], **kw)
@@ -61,20 +62,21 @@ def _run(dev, a, mesh=None):
 def test_sharded_step_matches_single_device(shape):
     assert len(jax.devices()) >= 8, "conftest must provide 8 cpu devices"
     dev, a = _setup()
-    p0, r0, d0, occ0 = _run(dev, a)
+    p0, r0, d0, occ0, st0 = _run(dev, a)
     mesh = make_mesh(8, shape=shape)
-    p1, r1, d1, occ1 = _run(dev, a, mesh)
+    p1, r1, d1, occ1, st1 = _run(dev, a, mesh)
     assert np.array_equal(np.asarray(p0), np.asarray(p1)), shape
     assert np.array_equal(np.asarray(r0), np.asarray(r1))
     assert np.allclose(np.asarray(d0), np.asarray(d1), equal_nan=True)
     assert np.array_equal(np.asarray(occ0), np.asarray(occ1))
+    assert int(st0) == int(st1)
 
 
 def test_sharded_occupancy_consistent():
     # committed occupancy == sum of the returned nets' usage
     dev, a = _setup()
     mesh = make_mesh(8, shape=(4, 2))
-    p1, r1, d1, occ1 = _run(dev, a, mesh)
+    p1, r1, d1, occ1, _ = _run(dev, a, mesh)
     paths = np.asarray(p1)
     N = dev.num_nodes
     occ = np.zeros(N, dtype=np.int64)
@@ -92,3 +94,22 @@ def test_batch_not_divisible_raises():
     mesh = make_mesh(8, shape=(4, 2))
     with pytest.raises(ValueError):
         _run(dev, a, mesh)
+
+
+def test_full_route_loop_sharded_matches_single_device():
+    """The COMPLETE negotiation loop (rip-up, coloring, history, bb
+    relaxation) under the mesh must converge and produce bit-identical
+    paths/occupancy to the single-device run — the determinism oracle the
+    reference buys with det_mutex logical clocks (det_mutex.cxx:100),
+    here a property of fixed-order XLA collectives.  (4, 2) exercises
+    both the net and node axes at once."""
+    f = synth_flow(num_luts=20, chan_width=10, seed=5)
+    rr, term = f.rr, f.term
+    res0 = Router(rr, RouterOpts(batch_size=16)).route(term)
+    mesh = make_mesh(8, shape=(4, 2))
+    res1 = Router(rr, RouterOpts(batch_size=16), mesh=mesh).route(term)
+    assert res0.success and res1.success
+    assert res0.iterations == res1.iterations
+    assert np.array_equal(res0.paths, res1.paths)
+    assert np.array_equal(res0.occ, res1.occ)
+    check_route(rr, term, res1.paths, occ=res1.occ)
