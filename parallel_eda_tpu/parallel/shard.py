@@ -20,11 +20,12 @@ TPU-native replacement for the reference's entire distributed stack
   ppermute halo-exchange pallas kernel is a later optimization).
 
 The full negotiation loop runs sharded: ``route.Router(rr, opts, mesh=m)``
-keeps occ/acc on the mesh across iterations and dispatches the fused
-rip-up/route/commit step (search.route_and_commit) per batch — the
-reference's complete iterating MPI router (load rebalance, plateau
-shrink) maps to the Router's existing schedule + re-jit on a smaller
-mesh.  Determinism is inherent: fixed mesh, fixed reduction order, and
+keeps every whole-circuit array (occ/acc/paths/bbs) on the mesh across
+iterations and dispatches the fused rip-up/route/commit/scatter step
+(search.route_batch_resident, which constrains each batch's rows to the
+"net" axis) per batch — the reference's complete iterating MPI router
+(load rebalance, plateau shrink) maps to the Router's existing schedule +
+re-jit on a smaller mesh.  Determinism is inherent: fixed mesh, fixed reduction order, and
 every cross-shard reduction is an integer sum or an elementwise min —
 sharded results are bit-identical to single-device (tested).
 """
@@ -80,10 +81,11 @@ def shard_graph(dev: DeviceRRGraph, mesh: Mesh) -> DeviceRRGraph:
 
 
 class ShardedRouter:
-    """Binds a (net, node) mesh to the fused route step via input
-    shardings; GSPMD propagates them through the jitted program.  For the
-    complete negotiation loop use route.Router(..., mesh=mesh), which
-    shares the same step."""
+    """Binds a (net, node) mesh to the fused single-step route kernel
+    (search.route_and_commit) via input shardings; GSPMD propagates them
+    through the jitted program.  For the complete negotiation loop use
+    route.Router(..., mesh=mesh), which runs the device-resident variant
+    (search.route_batch_resident) under the same mesh."""
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh

@@ -37,7 +37,8 @@ import numpy as np
 from ..rr.graph import RRGraph
 from ..rr.terminals import NetTerminals
 from .device_graph import DeviceRRGraph, to_device
-from .search import route_and_commit
+from .search import (conflict_subset, overuse_summary, reroute_mask,
+                     route_batch_resident, wirelength_on_device)
 
 
 @dataclass
@@ -92,39 +93,26 @@ class RouteResult:
     total_relax_steps: int = 0
 
 
-def _color_schedule(idx: np.ndarray, paths: np.ndarray, occ: np.ndarray,
-                    cap: np.ndarray, N: int):
-    """Greedy-color the net conflict graph (nets sharing an overused node);
-    each color class becomes its own commit group, serialising exactly the
-    nets that are fighting while keeping independent nets concurrent."""
-    over_nodes = np.where(occ > cap)[0]
-    if len(over_nodes) == 0:
-        return [idx]
-    over_set = np.zeros(N + 1, dtype=bool)
-    over_set[over_nodes] = True
-    users = {}
-    net_over = {}
-    for r in idx:
-        p = paths[r].ravel()
-        p = p[p < N]
-        ov = np.unique(p[over_set[p]])
-        net_over[r] = ov
-        for v in ov:
-            users.setdefault(int(v), []).append(r)
-    color = {}
-    for r in idx:
-        taken = set()
-        for v in net_over[r]:
-            for peer in users[int(v)]:
-                if peer != r and peer in color:
-                    taken.add(color[peer])
+def _color_schedule(idx: np.ndarray, conflict: np.ndarray):
+    """Greedy-color the net conflict graph (nets sharing an overused node;
+    conflict [I, I] bool from search.conflict_subset); each color class
+    becomes its own commit group, serialising exactly the nets that are
+    fighting while keeping independent nets concurrent (the reference's
+    coloring schedule, custom_vertex_coloring …cxx:3323)."""
+    n = len(idx)
+    color = np.zeros(n, dtype=np.int64)
+    for i in range(1, n):
+        taken = np.unique(color[:i][conflict[i, :i]])
         c = 0
-        while c in taken:
+        for t in taken:          # taken is sorted: first gap wins
+            if t != c:
+                break
             c += 1
-        color[r] = c
-    ncolors = max(color.values()) + 1
-    return [np.array([r for r in idx if color[r] == c], dtype=idx.dtype)
-            for c in range(ncolors)]
+        color[i] = c
+    ncolors = int(color.max()) + 1
+    if ncolors == 1:
+        return [idx]
+    return [idx[color == c] for c in range(ncolors)]
 
 
 def write_stats_files(stats_dir: str, result: "RouteResult") -> None:
@@ -244,42 +232,45 @@ class Router:
             # exactly 1 zeroes the congestion term and kills negotiation
             crit = np.minimum(np.asarray(crit, dtype=np.float32), 0.99)
 
+        # the tunneled TPU moves ~2 MB/s host<->device, so every
+        # whole-circuit array lives on device for the entire call; the
+        # host loop moves net indices in and scalars out (search.py
+        # "device-resident stepping")
         occ = self._put_node(jnp.zeros(N, dtype=jnp.int32))
         acc = self._put_node(jnp.ones(N, dtype=jnp.float32))
-        cap_np = np.asarray(rr.capacity, dtype=np.int64)
-
-        paths = np.full((R, Smax, self.max_len), N, dtype=np.int32)
-        sink_delay = np.full((R, Smax), np.inf, dtype=np.float32)
-        routed_once = np.zeros(R, dtype=bool)
-        all_reached = np.zeros(R, dtype=bool)
-
-        bb = np.stack([term.bb_xmin, term.bb_xmax,
-                       term.bb_ymin, term.bb_ymax], axis=1).astype(np.int32)
-        full_bb = np.array([0, rr.grid.nx + 1, 0, rr.grid.ny + 1],
-                           dtype=np.int32)
-        sinks_np = term.sinks.astype(np.int32)
-        source_np = term.source.astype(np.int32)
+        paths = jnp.full((R, Smax, self.max_len), N, dtype=jnp.int32)
+        sink_delay = jnp.full((R, Smax), jnp.inf, dtype=jnp.float32)
+        all_reached = jnp.zeros(R, dtype=bool)
+        bb = jnp.asarray(np.stack(
+            [term.bb_xmin, term.bb_xmax, term.bb_ymin, term.bb_ymax],
+            axis=1).astype(np.int32))
+        full_bb = jnp.asarray(np.array(
+            [0, rr.grid.nx + 1, 0, rr.grid.ny + 1], dtype=np.int32))
+        source_d = jnp.asarray(term.source.astype(np.int32))
+        sinks_d = jnp.asarray(term.sinks.astype(np.int32))
         nsinks_np = term.num_sinks.astype(np.int64)
 
         pres_fac = opts.initial_pres_fac
-        result = RouteResult(False, 0, paths, sink_delay, None, 0)
+        result = RouteResult(False, 0, None, None, None, 0)
+        n_over = -1                      # previous iteration's overuse
+        crit_d = None                    # uploaded once; refreshed on cb
 
         for it in range(1, opts.max_router_iterations + 1):
             t0 = time.time()
             it_steps = 0
-            occ_np = np.asarray(occ)
             if it <= opts.incremental_after:
-                reroute = np.ones(R, dtype=bool)
+                idx = np.arange(R)
             else:
-                # nets using any overused node (sentinel N maps to False)
-                over_p1 = np.append(occ_np > cap_np, False)
-                reroute = over_p1[paths].any(axis=(1, 2))
-                reroute |= ~routed_once
-                reroute |= ~all_reached
-            idx = np.where(reroute)[0]
+                rrm = np.asarray(reroute_mask(dev, occ, paths, all_reached))
+                idx = np.where(rrm)[0]
 
-            if it > 1 and len(idx) > 1:
-                groups = _color_schedule(idx, paths, occ_np, cap_np, N)
+            if it > 1 and len(idx) > 1 and n_over > 0:
+                I = _pow2_at_least(len(idx))
+                K = _pow2_at_least(min(max(n_over, 1), 4096))
+                idx_pad = _pad_to(idx.astype(np.int32), I, -1)
+                conflict = np.asarray(conflict_subset(
+                    dev, occ, paths, jnp.asarray(idx_pad), K))
+                groups = _color_schedule(idx, conflict[:len(idx), :len(idx)])
             else:
                 groups = [idx]
             # fanout-homogeneous batches: fewer wasted waves
@@ -288,52 +279,40 @@ class Router:
                 g = g[np.argsort(-nsinks_np[g], kind="stable")]
                 batches.extend(g[lo:lo + B] for lo in range(0, len(g), B))
 
+            # one static wave cap for every batch: the wave loop is a
+            # device while_loop that exits early once all sinks are done,
+            # so the cap costs nothing and every batch shares one program
+            waves = max(1, math.ceil(Smax / opts.sink_group))
+            if crit_d is None:
+                crit_d = jnp.asarray(crit)
             for sel in batches:
                 nsel = len(sel)
                 b_valid = np.zeros(B, dtype=bool)
                 b_valid[:nsel] = True
-                b_paths = _pad_to(paths[sel], B, N)
-
-                max_ns = int(nsinks_np[sel].max())
-                waves = _pow2_at_least(
-                    max(1, math.ceil(max_ns / opts.sink_group)))
-                # fused rip-up + route + commit, one device dispatch; each
-                # net is costed against the occupancy of *everyone else*
-                # (serial rip-up-one-net-at-a-time view, route_timing.c:399)
-                p, reached, delay, occ, steps = route_and_commit(
+                # fused rip-up + route + commit + scatter-back, one device
+                # dispatch; each net is costed against the occupancy of
+                # *everyone else* (serial rip-up-one-net-at-a-time view,
+                # route_timing.c:399)
+                (paths, sink_delay, all_reached, bb, occ,
+                 steps) = route_batch_resident(
                     dev, occ, acc, jnp.float32(pres_fac),
-                    self._put_batch(b_paths),
-                    self._put_batch(_pad_to(source_np[sel], B, 0)),
-                    self._put_batch(_pad_to(sinks_np[sel], B, -1)),
-                    self._put_batch(_pad_to(bb[sel], B, 0)),
-                    self._put_batch(_pad_to(crit[sel], B, 0.0)),
+                    paths, sink_delay, all_reached, bb,
+                    source_d, sinks_d, crit_d,
                     self._put_batch(_pad_to(sel.astype(np.int32), B, 0)),
-                    self._put_batch(b_valid),
-                    self.max_len, self.max_len, waves, opts.sink_group)
+                    self._put_batch(b_valid), full_bb,
+                    self.max_len, self.max_len, waves, opts.sink_group,
+                    self.mesh)
                 it_steps += int(steps)
-
-                paths[sel] = np.asarray(p[:nsel])
-                sink_delay[sel] = np.asarray(delay[:nsel])
-                routed_once[sel] = True
-                reached_np = np.asarray(reached[:nsel])
-                smask = np.arange(Smax)[None, :] < nsinks_np[sel][:, None]
-                ok = (reached_np | ~smask).all(axis=1)
-                all_reached[sel] = ok
-                # a sink was unreachable inside its bounding box: retry
-                # with the full device (place_and_route.c bb relaxation)
-                bb[sel[~ok]] = full_bb
                 result.total_net_routes += nsel
 
-            occ_np = np.asarray(occ)
-            over = np.maximum(0, occ_np - cap_np)
-            n_over = int((over > 0).sum())
+            n_over, over_total = (int(v) for v in overuse_summary(dev, occ))
             result.total_relax_steps += it_steps
             result.stats.append(RouteStats(
-                it, n_over, int(over.sum()), len(idx), time.time() - t0,
+                it, n_over, over_total, len(idx), time.time() - t0,
                 relax_steps=it_steps, batches=len(batches),
                 overuse_pct=100.0 * n_over / max(1, N)))
 
-            if n_over == 0 and all_reached.all():
+            if n_over == 0 and bool(jnp.all(all_reached)):
                 result.success = True
                 result.iterations = it
                 break
@@ -345,17 +324,17 @@ class Router:
             pres_fac = min(opts.max_pres_fac, pres_fac * opts.pres_fac_mult)
 
             if timing_cb is not None:
-                result.occ = occ_np
+                result.sink_delay = np.asarray(sink_delay)
                 crit = np.minimum(
                     np.asarray(timing_cb(result), dtype=np.float32), 0.99)
+                crit_d = None            # re-upload next iteration
         else:
             result.iterations = opts.max_router_iterations
 
+        result.wirelength = int(wirelength_on_device(dev, paths))
+        result.paths = np.asarray(paths)
+        result.sink_delay = np.asarray(sink_delay)
         result.occ = np.asarray(occ)
-        union = np.zeros(N + 1, dtype=bool)
-        union[paths.ravel()] = True
-        is_wire = np.asarray(self.dev.is_wire)
-        result.wirelength = int(union[:N][is_wire].sum())
         if opts.stats_dir:
             write_stats_files(opts.stats_dir, result)
         return result

@@ -84,24 +84,42 @@ def _relax(dev: DeviceRRGraph, cong_c: jnp.ndarray, crit_c: jnp.ndarray,
     tdel0 = jnp.where(seed, seed_tdel, 0.0)
     prev0 = jnp.full((B, N), -1, jnp.int32)
 
+    # ELL slots are processed in blocks of DB: one [B, N, DB] gather +
+    # min-reduce per block.  Per-slot fori_loop (DB=1) would issue D tiny
+    # ops whose fixed device overhead dominates on small graphs; a single
+    # [B, N, D] gather (DB=D) multiplies peak memory by D and OOMs large
+    # graphs.  Blocks bound memory at [B, N, DB] while keeping the
+    # sequential chain short (ceil(D/DB) ops).
+    DB = min(8, D)
+    nblocks = -(-D // DB)
+    arangeN = jnp.arange(N)[None, :]
+
     def step(state):
         dist, prev, tdel, _, it = state
 
-        def slot(d, carry):
-            best, bsrc, btdel = carry
-            s = dev.ell_src[:, d]                                # [N]
-            w = dev.ell_delay[:, d]
-            valid = dev.ell_valid[:, d]
-            cand = dist[:, s] + crit_c * w[None, :] + cong_c     # [B, N]
-            cand = jnp.where(valid[None, :], cand, INF)
-            better = cand < best
-            best = jnp.where(better, cand, best)
-            bsrc = jnp.where(better, s[None, :], bsrc)
-            btdel = jnp.where(better, tdel[:, s] + w[None, :], btdel)
-            return best, bsrc, btdel
+        def blk(b, carry):
+            best0, bsrc0, btdel0 = carry
+            # the last block is shifted to stay in range; the overlap
+            # re-evaluates a few slots, harmless under min
+            d0 = jnp.minimum(b * DB, D - DB)
+            s = lax.dynamic_slice_in_dim(dev.ell_src, d0, DB, axis=1)
+            w = lax.dynamic_slice_in_dim(dev.ell_delay, d0, DB, axis=1)
+            valid = lax.dynamic_slice_in_dim(dev.ell_valid, d0, DB, axis=1)
+            ds = dist[:, s]                                    # [B, N, DB]
+            cand3 = ds + crit_c[:, :, None] * w[None] + cong_c[:, :, None]
+            cand3 = jnp.where(valid[None], cand3, INF)
+            bbest = jnp.min(cand3, axis=2)                     # [B, N]
+            slot = jnp.argmin(cand3, axis=2)
+            bsrc = s[arangeN, slot]
+            w_pick = w[arangeN, slot]
+            btdel = jnp.take_along_axis(tdel, bsrc, axis=1) + w_pick
+            better = bbest < best0
+            return (jnp.where(better, bbest, best0),
+                    jnp.where(better, bsrc, bsrc0),
+                    jnp.where(better, btdel, btdel0))
 
         best, bsrc, btdel = lax.fori_loop(
-            0, D, slot,
+            0, nblocks, blk,
             (jnp.full((B, N), INF, jnp.float32),
              jnp.full((B, N), -1, jnp.int32),
              jnp.zeros((B, N), jnp.float32)))
@@ -168,6 +186,10 @@ def route_net_batch(dev: DeviceRRGraph, cong: jnp.ndarray,
     bb [B, 4]; crit [B, S] per-sink criticalities; net_key [B] stable ids
     for the symmetry-breaking jitter.
 
+    The sink waves run as a device while_loop (one compiled wave body, not
+    num_waves unrolled copies — compile time, and early exit when every
+    net's sinks are done); num_waves only caps the trip count.
+
     Returns (paths [B, S, L] sentinel-N-padded sink->tree segments,
     reached [B, S], sink_delay [B, S], usage [B, N] tree-node masks,
     relax_steps scalar — total Bellman-Ford sweeps, the perf_t
@@ -189,15 +211,11 @@ def route_net_batch(dev: DeviceRRGraph, cong: jnp.ndarray,
 
     arangeB = jnp.arange(B)
     # seed with one slot of slack so sentinel scatters drop cleanly
-    seed = jnp.zeros((B, N + 1), bool).at[arangeB, source].set(True)
-    tdel_tree = jnp.zeros((B, N), jnp.float32)
-    remaining = sinks >= 0                                        # [B, S]
-    paths = jnp.full((B, S, max_len), N, jnp.int32)
-    delay = jnp.full((B, S), INF, jnp.float32)
-    reached_all = jnp.zeros((B, S), bool)
+    seed0 = jnp.zeros((B, N + 1), bool).at[arangeB, source].set(True)
 
-    relax_steps = jnp.int32(0)
-    for _ in range(num_waves):
+    def wave_body(state):
+        (seed, tdel_tree, remaining, paths, delay, reached_all,
+         relax_steps, wave) = state
         # wave criticality: strongest remaining sink drives the delay weight
         crit_w = jnp.max(jnp.where(remaining, crit, 0.0), axis=1)  # [B]
         cong_c = (1.0 - crit_w)[:, None] * cong * jitter
@@ -241,6 +259,22 @@ def route_net_batch(dev: DeviceRRGraph, cong: jnp.ndarray,
             arangeB[:, None], flat].set(True)
         tdel_tree = jnp.where(newly[:, :N], tdel, tdel_tree)
         seed = seed | newly
+        return (seed, tdel_tree, remaining, paths, delay, reached_all,
+                relax_steps, wave + 1)
+
+    def wave_cond(state):
+        remaining, wave = state[2], state[7]
+        # a sink whose score stayed INF (unreachable in-box) keeps
+        # remaining true but can't make progress: the static wave cap
+        # bounds the loop exactly like the old unrolled version
+        return jnp.any(remaining) & (wave < num_waves)
+
+    state0 = (seed0, jnp.zeros((B, N), jnp.float32), sinks >= 0,
+              jnp.full((B, S, max_len), N, jnp.int32),
+              jnp.full((B, S), INF, jnp.float32),
+              jnp.zeros((B, S), bool), jnp.int32(0), jnp.int32(0))
+    (seed, _, _, paths, delay, reached_all, relax_steps,
+     _) = lax.while_loop(wave_cond, wave_body, state0)
 
     return paths, reached_all, delay, seed[:, :N], relax_steps
 
@@ -308,3 +342,129 @@ def usage_from_paths(path: jnp.ndarray, num_nodes_p1: jnp.ndarray):
 def occupancy_delta(usage: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Sum per-net usage masks into an occupancy delta [N] (int32)."""
     return jnp.sum(usage & valid[:, None], axis=0, dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Device-resident stepping.
+#
+# The tunneled single-chip TPU moves ~2 MB/s host<->device, so the Router
+# keeps ALL route state (paths, per-sink delays, reached flags, bounding
+# boxes, occupancy, history) resident on the device for the whole route()
+# call.  Each batch step transfers only the selected net indices in and one
+# scalar out; the reference's analogue is that its routers never serialize
+# route trees either — state lives in shared memory / MPI windows
+# (route.h:70-165 trees, congestion_t[] occupancy).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_steps", "max_len", "num_waves", "group", "mesh"),
+    donate_argnames=("occ", "paths", "sink_delay", "all_reached", "bb"))
+def route_batch_resident(dev: DeviceRRGraph, occ, acc, pres_fac,
+                         paths, sink_delay, all_reached, bb,
+                         source_all, sinks_all, crit_all,
+                         sel, valid, full_bb,
+                         max_steps: int, max_len: int, num_waves: int,
+                         group: int, mesh=None):
+    """One fused batch step against device-resident whole-circuit state.
+
+    paths [R, S, L] / sink_delay [R, S] / all_reached [R] / bb [R, 4] are
+    the resident arrays; sel [B] picks this batch's nets (valid [B] masks
+    padding).  Gathers the batch rows, rips up, routes every net against
+    the occupancy view of everyone-but-itself, commits, scatters the rows
+    back, and widens the bounding box of any net with an unreachable sink
+    to the whole device (place_and_route.c bb relaxation).  Donation makes
+    the update in-place on device.
+
+    Returns (paths, sink_delay, all_reached, bb, occ, relax_steps).
+    """
+    N = dev.num_nodes
+    R = paths.shape[0]
+
+    b_paths = paths[sel]
+    b_src = source_all[sel]
+    b_sinks = sinks_all[sel]
+    b_bb = bb[sel]
+    b_crit = crit_all[sel]
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def c(x, *spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+        b_paths = c(b_paths, "net", None, None)
+        b_src = c(b_src, "net")
+        b_sinks = c(b_sinks, "net", None)
+        b_bb = c(b_bb, "net", None)
+        b_crit = c(b_crit, "net", None)
+
+    nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
+    old_usage = usage_from_paths(b_paths, nodes_p1) & valid[:, None]
+    occ_rip = occ - jnp.sum(old_usage, axis=0, dtype=jnp.int32)
+    occ_view = occ[None, :] - old_usage.astype(jnp.int32)
+
+    cong = congestion_cost(dev, occ_view, acc, pres_fac)
+    p, reached, delay, usage, relax_steps = route_net_batch(
+        dev, cong, b_src, b_sinks, b_bb, b_crit, sel.astype(jnp.int32),
+        max_steps, max_len, num_waves, group)
+    usage = usage & valid[:, None]
+    occ_new = occ_rip + jnp.sum(usage, axis=0, dtype=jnp.int32)
+
+    smask = b_sinks >= 0
+    ok = (reached | ~smask).all(axis=1)
+    new_bb = jnp.where(ok[:, None], b_bb, full_bb[None, :])
+
+    # padded rows scatter out of range and are dropped
+    sel_v = jnp.where(valid, sel, R).astype(jnp.int32)
+    paths = paths.at[sel_v].set(p, mode="drop")
+    sink_delay = sink_delay.at[sel_v].set(delay, mode="drop")
+    all_reached = all_reached.at[sel_v].set(ok, mode="drop")
+    bb = bb.at[sel_v].set(new_bb, mode="drop")
+    return paths, sink_delay, all_reached, bb, occ_new, relax_steps
+
+
+@jax.jit
+def reroute_mask(dev: DeviceRRGraph, occ, paths, all_reached):
+    """Nets that must reroute: any overused node on their tree, or an
+    unreached sink (the reference's per-iteration rip-up predicate,
+    route_timing.c should_route_net semantics)."""
+    over_p1 = jnp.append(occ > dev.capacity, False)
+    return over_p1[paths].any(axis=(1, 2)) | ~all_reached
+
+
+@jax.jit
+def overuse_summary(dev: DeviceRRGraph, occ):
+    """(num overused nodes, total overuse) as device scalars."""
+    over = jnp.maximum(0, occ - dev.capacity)
+    return (over > 0).sum(dtype=jnp.int32), over.sum(dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("K",))
+def conflict_subset(dev: DeviceRRGraph, occ, paths, idx_pad, K: int):
+    """Conflict matrix among a padded subset of nets: C[i, j] = nets
+    idx_pad[i] and idx_pad[j] share an overused node.  K bounds the number
+    of overused nodes inspected (ascending node order; extras ignored —
+    the coloring is a heuristic).  The MXU does the pairwise intersection.
+
+    Replaces the host-side O(nets x path-length) dict pass of the old
+    _color_schedule (the reference's overlap graph is build_overlap_graph,
+    partitioning_multi_sink_delta_stepping_route.cxx:3563)."""
+    N = dev.num_nodes
+    I = idx_pad.shape[0]
+    over_ids = jnp.nonzero(occ > dev.capacity, size=K, fill_value=N + 1)[0]
+    p = paths[jnp.clip(idx_pad, 0)].reshape(I, -1)
+    pos = jnp.searchsorted(over_ids, p).astype(jnp.int32)
+    posc = jnp.clip(pos, 0, K - 1)
+    hit = over_ids[posc] == p
+    U = jnp.zeros((I, K + 1), jnp.float32).at[
+        jnp.arange(I)[:, None], jnp.where(hit, posc, K)].set(1.0)[:, :K]
+    return (U @ U.T) > 0.5
+
+
+@jax.jit
+def wirelength_on_device(dev: DeviceRRGraph, paths):
+    """Number of distinct CHANX/CHANY nodes used by any net."""
+    N = dev.num_nodes
+    used = jnp.zeros(N + 1, bool).at[paths.ravel()].set(True)[:N]
+    return jnp.sum(used & dev.is_wire, dtype=jnp.int32)

@@ -26,7 +26,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..rr.graph import RRGraph
+from ..rr.graph import CHANX, CHANY, RRGraph
 from ..rr.terminals import NetTerminals
 
 
@@ -81,7 +81,7 @@ class SerialRouter:
         # / parallel_route/router.cxx:445): cheapest-possible cost per tile
         # of remaining manhattan distance = min wire base cost / longest
         # segment length
-        wire = (rr.node_type == 4) | (rr.node_type == 5)
+        wire = (rr.node_type == CHANX) | (rr.node_type == CHANY)
         self.lmax = max(1, int((rr.xhigh - rr.xlow + rr.yhigh
                                 - rr.ylow)[wire].max()) + 1)
         self.min_wire_cost = float(self.base[wire].min()) / self.lmax
@@ -115,7 +115,7 @@ class SerialRouter:
                 for v in trees[i]:
                     occ[v] -= 1
                 trees[i] = self._route_net(i, term, occ, acc, pres_fac,
-                                           bbs[i], crit)
+                                           bbs, crit)
                 for v in trees[i]:
                     occ[v] += 1
                 pops += self._last_pops
@@ -156,7 +156,7 @@ class SerialRouter:
                 pending = rest
             out_trees.append(rows)
         res.trees = out_trees
-        wire = (rr.node_type == 4) | (rr.node_type == 5)   # CHANX/CHANY
+        wire = (rr.node_type == CHANX) | (rr.node_type == CHANY)
         used = np.zeros(N, dtype=bool)
         for t in trees:
             for v in t:
@@ -165,7 +165,7 @@ class SerialRouter:
         return res
 
     def _route_net(self, i: int, term: NetTerminals, occ, acc,
-                   pres_fac: float, bb, crit) -> dict:
+                   pres_fac: float, bbs, crit) -> dict:
         """Incremental multi-sink A* (route_timing.c:399
         timing_driven_route_net + :693 expected-cost lookahead): seed with
         the growing tree, route each remaining sink (most critical
@@ -177,6 +177,7 @@ class SerialRouter:
         sinks = [int(term.sinks[i, s]) for s in range(ns)]
         tree = {src: -1}
         self._last_pops = 0
+        bb = bbs[i]
         xlo, xhi_b, ylo, yhi_b = (int(bb[0]), int(bb[1]),
                                   int(bb[2]), int(bb[3]))
         xlow, xhigh = rr.xlow, rr.xhigh
@@ -238,9 +239,11 @@ class SerialRouter:
                             * (1.0 - cw)
                         heapq.heappush(heap, (nd + h, u))
             if not found:
-                # bb too tight: retry this sink with the full device
+                # bb too tight: retry this sink with the full device and
+                # keep the widened box for later reroutes of this net
                 if (xlo, xhi_b, ylo, yhi_b) != full_bb:
                     xlo, xhi_b, ylo, yhi_b = full_bb
+                    bbs[i] = full_bb
                     continue
                 raise RuntimeError(
                     f"net {i}: sink unreachable even on full device")
