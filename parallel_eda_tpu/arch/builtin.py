@@ -8,7 +8,8 @@ tree — the reference bundles no arch XMLs).
 
 from __future__ import annotations
 
-from .model import Arch, SegmentInf, SwitchInf, make_clb_type, make_io_type
+from .model import (Arch, ColumnSpec, SegmentInf, SwitchInf, make_clb_type,
+                    make_hard_type, make_io_type)
 
 
 def k6_n10_arch() -> Arch:
@@ -35,6 +36,25 @@ def k6_n10_arch() -> Arch:
         make_clb_type(index=1, K=arch.K, N=arch.N, I=arch.I,
                       T_comb=261e-12, T_setup=66e-12, T_clk_to_q=124e-12),
     ]
+    return arch
+
+
+def k6_n10_mem_arch(addr_bits: int = 6, data_bits: int = 8,
+                    mem_start: int = 4, mem_repeat: int = 6) -> Arch:
+    """k6_N10 plus a single-port RAM column type (Stratix-IV-style
+    heterogeneous device: io ring, CLB interior, periodic 'bram' columns;
+    physical_types.h t_type_descriptor + SetupGrid.c column fill).  The
+    'spram' .subckt model maps onto it (pins: addr + data-in + we, then
+    data-out, then clk)."""
+    arch = k6_n10_arch()
+    arch.name = "k6_N10_mem"
+    num_in = addr_bits + data_bits + 1          # addr, din, we
+    arch.block_types.append(make_hard_type(
+        "bram", index=2, num_in=num_in, num_out=data_bits,
+        T_comb=1.5e-9, T_setup=100e-12, T_clk_to_q=440e-12))
+    arch.column_types = [ColumnSpec("bram", start=mem_start,
+                                    repeat=mem_repeat)]
+    arch.hard_models = {"spram": "bram"}
     return arch
 
 

@@ -103,6 +103,20 @@ class BlockType:
 
 
 @dataclass
+class ColumnSpec:
+    """Periodic column assignment of a heterogeneous block type
+    (Stratix-IV-style RAM/DSP columns).
+
+    Reference: grid column assignment in vpr/SRC/base/SetupGrid.c
+    (t_grid_loc_def col semantics): interior columns x with
+    ``(x - start) % repeat == 0`` hold ``type_name`` blocks instead of
+    CLBs."""
+    type_name: str
+    start: int = 4
+    repeat: int = 8
+
+
+@dataclass
 class Arch:
     """Full device architecture.
 
@@ -116,6 +130,11 @@ class Arch:
     I: int = 33
     io_capacity: int = 8
     block_types: List[BlockType] = field(default_factory=list)
+    # heterogeneous column assignments (empty = homogeneous CLB interior)
+    column_types: List[ColumnSpec] = field(default_factory=list)
+    # hard-block models (.subckt name -> block type name), read_blif.c
+    # model lookup equivalent
+    hard_models: Dict[str, str] = field(default_factory=dict)
     segments: List[SegmentInf] = field(default_factory=list)
     switches: List[SwitchInf] = field(default_factory=list)
     # fraction of channel tracks each OPIN / IPIN connects to; if the arch
@@ -167,6 +186,27 @@ def make_clb_type(index: int, K: int, N: int, I: int,
     pin_class_of = [0] * I + [1] * N + [2]
     return BlockType(
         name="clb", index=index, num_pins=num_pins, capacity=1,
+        pin_classes=pin_classes, pin_class_of=pin_class_of, is_io=False,
+        T_comb=T_comb, T_setup=T_setup, T_clk_to_q=T_clk_to_q,
+    )
+
+
+def make_hard_type(name: str, index: int, num_in: int, num_out: int,
+                   T_comb: float = 1.5e-9, T_setup: float = 100e-12,
+                   T_clk_to_q: float = 400e-12) -> BlockType:
+    """A hard block type (RAM / DSP column block): num_in data+address
+    input pins (one class), num_out output pins (one class), one clock.
+    Stratix-IV-style heterogeneous tile (physical_types.h
+    t_type_descriptor with its own pin classes and timing)."""
+    num_pins = num_in + num_out + 1
+    pin_classes = [
+        PinClass(PIN_CLASS_RECEIVER, list(range(0, num_in))),
+        PinClass(PIN_CLASS_DRIVER, list(range(num_in, num_in + num_out))),
+        PinClass(PIN_CLASS_RECEIVER, [num_in + num_out], is_clock=True),
+    ]
+    pin_class_of = [0] * num_in + [1] * num_out + [2]
+    return BlockType(
+        name=name, index=index, num_pins=num_pins, capacity=1,
         pin_classes=pin_classes, pin_class_of=pin_class_of, is_io=False,
         T_comb=T_comb, T_setup=T_setup, T_clk_to_q=T_clk_to_q,
     )

@@ -66,10 +66,18 @@ def prepare(nl: LogicalNetlist, arch: Arch, chan_width: int,
     if pnl is None:
         pnl = pack_netlist(nl, arch)
     t_pack = time.time() - t0
-    n_clb = sum(1 for i in range(pnl.num_blocks)
-                if not pnl.block_type(i).is_io)
-    n_io = pnl.num_blocks - n_clb
-    grid = size_grid(n_clb, n_io, arch, nx=nx, ny=ny)
+    n_io = n_clb = 0
+    hard_counts: dict = {}
+    for i in range(pnl.num_blocks):
+        bt = pnl.block_type(i)
+        if bt.is_io:
+            n_io += 1
+        elif bt.name == "clb":
+            n_clb += 1
+        else:
+            hard_counts[bt.name] = hard_counts.get(bt.name, 0) + 1
+    grid = size_grid(n_clb, n_io, arch, nx=nx, ny=ny,
+                     hard_counts=hard_counts)
     pos = initial_placement(pnl, grid, seed=seed)
     t0 = time.time()
     rr = build_rr_graph(arch, grid, chan_width=chan_width)

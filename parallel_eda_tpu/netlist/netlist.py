@@ -14,9 +14,10 @@ PRIM_INPAD = 0
 PRIM_OUTPAD = 1
 PRIM_LUT = 2
 PRIM_FF = 3
+PRIM_HARD = 4        # hard macro instance (.subckt: RAM / DSP block)
 
 _PRIM_NAMES = {PRIM_INPAD: "inpad", PRIM_OUTPAD: "outpad",
-               PRIM_LUT: "lut", PRIM_FF: "ff"}
+               PRIM_LUT: "lut", PRIM_FF: "ff", PRIM_HARD: "hard"}
 
 
 @dataclass
@@ -27,6 +28,10 @@ class Primitive:
     output: Optional[str] = None                      # output net name
     clock: Optional[str] = None                       # FF clock net
     truth_table: List[str] = field(default_factory=list)  # .names cover rows
+    # PRIM_HARD only: .subckt model name + multi-bit output nets (inputs
+    # and outputs are positional against the hard block type's pin order)
+    model: Optional[str] = None
+    outputs: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -49,12 +54,17 @@ class LogicalNetlist:
         self.net_sinks.clear()
         clocks = set()
         for i, p in enumerate(self.primitives):
-            if p.output is not None:
-                if p.output in self.net_driver:
-                    raise ValueError(f"net {p.output} multiply driven")
-                self.net_driver[p.output] = i
+            outs = [p.output] if p.output is not None else []
+            outs += p.outputs
+            for o in outs:
+                if o is None:
+                    continue            # unconnected hard-macro port
+                if o in self.net_driver:
+                    raise ValueError(f"net {o} multiply driven")
+                self.net_driver[o] = i
             for n in p.inputs:
-                self.net_sinks.setdefault(n, []).append(i)
+                if n is not None:
+                    self.net_sinks.setdefault(n, []).append(i)
             if p.clock is not None:
                 self.net_sinks.setdefault(p.clock, []).append(i)
                 clocks.add(p.clock)

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 from typing import List
 
-from .netlist import (LogicalNetlist, Primitive,
+from .netlist import (LogicalNetlist, Primitive, PRIM_HARD,
                       PRIM_INPAD, PRIM_OUTPAD, PRIM_LUT, PRIM_FF)
 
 # truth tables (BLIF cover rows) for the mapped cells
@@ -130,6 +130,53 @@ def array_multiplier(n: int = 16, registered: bool = True,
     for k, p in enumerate(prod):
         out = _ff(nl, f"rp{k}", p, clk) if registered else p
         nl.add(Primitive(name=f"out:p{k}", kind=PRIM_OUTPAD, inputs=[out]))
+    nl.finalize()
+    return nl
+
+
+def ram_pipeline(n_mems: int = 3, addr_bits: int = 6, data_bits: int = 8,
+                 name: str = None) -> LogicalNetlist:
+    """A heterogeneous benchmark: an address counter feeds a chain of
+    single-port RAM macros ('spram' .subckt -> 'bram' block type,
+    arch.builtin.k6_n10_mem_arch), each RAM's data-out XOR-mixed with the
+    external data word before feeding the next.  Exercises hard-macro
+    packing, RAM-column placement, and LUT<->RAM routing the way a
+    Stratix-IV-class netlist does."""
+    nl = LogicalNetlist(name=name or f"rampipe{n_mems}")
+    clk = "clk"
+    nl.add(Primitive(name=clk, kind=PRIM_INPAD, output=clk))
+    we = "we"
+    nl.add(Primitive(name=we, kind=PRIM_INPAD, output=we))
+    data = [f"d{i}" for i in range(data_bits)]
+    for s in data:
+        nl.add(Primitive(name=s, kind=PRIM_INPAD, output=s))
+
+    # address counter: a' = a + 1 (ripple XOR/AND chain of registered bits)
+    addr = [f"addr{i}" for i in range(addr_bits)]
+    carry = None
+    for i in range(addr_bits):
+        if carry is None:
+            d = _lut(nl, f"addr_n{i}", [addr[i]], ["0 1"])   # invert
+            carry = addr[i]
+        else:
+            d = _lut(nl, f"addr_n{i}", [addr[i], carry], _XOR2)
+            carry = _lut(nl, f"addr_c{i}", [addr[i], carry], _AND2)
+        _ff(nl, addr[i], d, clk)
+
+    # RAM chain with XOR mixing between stages
+    din = list(data)
+    for m in range(n_mems):
+        dout = [f"m{m}_q{j}" for j in range(data_bits)]
+        nl.add(Primitive(name=f"spram_{m}", kind=PRIM_HARD, model="spram",
+                         inputs=addr + din + [we], outputs=dout,
+                         clock=clk))
+        if m + 1 < n_mems:
+            din = [_lut(nl, f"mix{m}_{j}", [dout[j], data[j]], _XOR2)
+                   for j in range(data_bits)]
+        else:
+            din = dout
+    for j, q in enumerate(din):
+        nl.add(Primitive(name=f"out:q{j}", kind=PRIM_OUTPAD, inputs=[q]))
     nl.finalize()
     return nl
 

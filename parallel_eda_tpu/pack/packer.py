@@ -23,8 +23,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set
 
 from ..arch.model import Arch
-from ..netlist.netlist import (LogicalNetlist, PRIM_INPAD, PRIM_OUTPAD,
-                               PRIM_LUT, PRIM_FF)
+from ..netlist.netlist import (LogicalNetlist, PRIM_HARD, PRIM_INPAD,
+                               PRIM_OUTPAD, PRIM_LUT, PRIM_FF)
 from ..netlist.packed import Block, PackedNetlist
 
 
@@ -164,6 +164,12 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
     for p in nl.primitives:
         if p.kind == PRIM_OUTPAD:
             pad_consumers[p.inputs[0]] = True
+        elif p.kind == PRIM_HARD:
+            # hard blocks live outside every cluster: their input nets
+            # must surface on cluster output pins
+            for n in p.inputs:
+                if n is not None:
+                    pad_consumers[n] = True
 
     def net_needed_outside(ci: int, net: str) -> bool:
         if net in pad_consumers:
@@ -173,7 +179,9 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
                 return True
         return False
 
-    # IO blocks first (inpads drive nets, outpads consume)
+    # IO blocks first (inpads drive nets, outpads consume), then hard
+    # macros 1:1 onto their matching heterogeneous block type
+    # (arch.hard_models .subckt-model lookup, read_blif.c semantics)
     for i, p in enumerate(nl.primitives):
         if p.kind == PRIM_INPAD:
             ni = pnl.add_net(p.output, is_global=(p.output in clocks))
@@ -185,6 +193,26 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
             blk = Block(name=p.name, type_name=io_t.name,
                         pin_nets=[ni, -1], prims=[i])
             pnl.blocks.append(blk)
+        elif p.kind == PRIM_HARD:
+            tname = arch.hard_models.get(p.model, p.model)
+            ht = arch.block_type(tname)
+            n_in = ht.num_input_pins
+            if len(p.inputs) > n_in or len(p.outputs) > ht.num_output_pins:
+                raise ValueError(
+                    f"hard macro {p.name} ({p.model}) exceeds block type "
+                    f"{tname} pins")
+            pin_nets = [-1] * ht.num_pins
+            for k, n in enumerate(p.inputs):
+                if n is not None:       # None = unconnected port
+                    pin_nets[k] = pnl.add_net(n)
+            for k, n in enumerate(p.outputs):
+                if n is not None:
+                    pin_nets[n_in + k] = pnl.add_net(n)
+            if p.clock is not None:
+                pin_nets[ht.num_pins - 1] = pnl.add_net(p.clock,
+                                                        is_global=True)
+            pnl.blocks.append(Block(name=p.name, type_name=tname,
+                                    pin_nets=pin_nets, prims=[i]))
 
     in_base = 0
     out_base = arch.I

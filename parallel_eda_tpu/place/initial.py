@@ -24,20 +24,29 @@ def initial_placement(pnl: PackedNetlist, grid: DeviceGrid,
 
     io_sites = [(x, y, z) for (x, y) in grid.io_sites()
                 for z in range(grid.io_capacity)]
-    clb_sites = [(x, y, 0) for (x, y) in grid.clb_sites()]
+    # per-type interior site pools (heterogeneous columns route each
+    # block type to its own columns, SetupGrid.c semantics)
+    type_sites = {}
+    for bi in range(pnl.num_blocks):
+        t = pnl.blocks[bi].type_name
+        if not pnl.block_type(bi).is_io and t not in type_sites:
+            type_sites[t] = [(x, y, 0) for (x, y) in grid.sites_of_type(t)]
     if rng is not None:
         rng.shuffle(io_sites)
-        rng.shuffle(clb_sites)
+        for s in type_sites.values():
+            rng.shuffle(s)
 
     pos = np.zeros((pnl.num_blocks, 3), dtype=np.int32)
-    io_i = clb_i = 0
+    io_i = 0
+    type_i = {t: 0 for t in type_sites}
     for bi, b in enumerate(pnl.blocks):
         if pnl.block_type(bi).is_io:
             if io_i >= len(io_sites):
                 raise ValueError("not enough IO sites")
             pos[bi] = io_sites[io_i]; io_i += 1
         else:
-            if clb_i >= len(clb_sites):
-                raise ValueError("not enough CLB sites")
-            pos[bi] = clb_sites[clb_i]; clb_i += 1
+            t = b.type_name
+            if type_i[t] >= len(type_sites[t]):
+                raise ValueError(f"not enough '{t}' sites")
+            pos[bi] = type_sites[t][type_i[t]]; type_i[t] += 1
     return pos

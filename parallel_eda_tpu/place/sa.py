@@ -87,6 +87,13 @@ class PlaceProblem:
     # block/site model
     is_io: jnp.ndarray         # bool [NB]
     ring_xy: jnp.ndarray       # int32 [NRING, 2] perimeter ring tile coords
+    # heterogeneous interior types (column-typed grids, SetupGrid.c):
+    # moves propose a column from the block's OWN type's column list so a
+    # RAM block can only land in RAM columns (io blocks: row 0, unused)
+    type_id: jnp.ndarray       # int32 [NB] interior type index
+    col_list: jnp.ndarray      # int32 [T, Cmax] interior columns per type
+    ncols: jnp.ndarray         # int32 [T]
+    col_idx_of_x: jnp.ndarray  # int32 [T, nx+2] nearest own-column index
     # timing model: delta-delay matrices (delay_lookup) padded to one
     # [4, nx+2, ny+2] stack ordered (clb_clb, io_clb, clb_io, io_io)
     delta: jnp.ndarray         # f32 [4, nx+2, ny+2]
@@ -172,6 +179,32 @@ def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid,
     is_io = np.array([pnl.block_type(i).is_io for i in range(NB)], dtype=bool)
     ring = np.array(grid.io_sites(), dtype=np.int32)
 
+    # interior type tables (heterogeneous columns)
+    itypes = ["clb"] + sorted({t for t in grid.col_types.values()})
+    tid_of = {t: i for i, t in enumerate(itypes)}
+    cols_by_t = {t: [x for x in range(1, grid.nx + 1)
+                     if grid.interior_type_name(x) == t] for t in itypes}
+    type_id = np.zeros(NB, dtype=np.int32)
+    for i in range(NB):
+        if not is_io[i]:
+            t = pnl.blocks[i].type_name
+            if t not in tid_of or not cols_by_t[t]:
+                raise ValueError(f"block type '{t}' has no columns")
+            type_id[i] = tid_of[t]
+    Cmax = max(1, max(len(c) for c in cols_by_t.values()))
+    col_list = np.zeros((len(itypes), Cmax), dtype=np.int32)
+    ncols = np.zeros(len(itypes), dtype=np.int32)
+    col_idx_of_x = np.zeros((len(itypes), grid.nx + 2), dtype=np.int32)
+    for t, cols in cols_by_t.items():
+        ti = tid_of[t]
+        cols = cols or [1]
+        col_list[ti, :len(cols)] = cols
+        col_list[ti, len(cols):] = cols[-1]
+        ncols[ti] = len(cols)
+        ca = np.array(cols)
+        for x in range(grid.nx + 2):
+            col_idx_of_x[ti, x] = int(np.abs(ca - x).argmin())
+
     # delta-delay stack [4, nx+2, ny+2]: (clb_clb, io_clb, clb_io, io_io);
     # the SAME array the host criticality path indexes (DelayLookup.stack)
     H, W = grid.nx + 2, grid.ny + 2
@@ -185,6 +218,8 @@ def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid,
         net_blk=jnp.asarray(net_blk), net_valid=jnp.asarray(net_valid),
         net_q=jnp.asarray(net_q), blk_net=jnp.asarray(blk_net),
         is_io=jnp.asarray(is_io), ring_xy=jnp.asarray(ring),
+        type_id=jnp.asarray(type_id), col_list=jnp.asarray(col_list),
+        ncols=jnp.asarray(ncols), col_idx_of_x=jnp.asarray(col_idx_of_x),
         delta=jnp.asarray(delta),
         nx=grid.nx, ny=grid.ny, io_cap=grid.io_capacity,
     )
@@ -250,15 +285,25 @@ def _propose(pp: PlaceProblem, pos, ring_idx, key, rlim, M: int):
     """Propose M moves: (block [M], new_pos [M,3], new_ring [M])."""
     NB = pp.num_blocks
     NRING = pp.ring_xy.shape[0]
-    k1, k2, k3, k4 = jax.random.split(key, 4)
+    k1, k2, k2b, k3, k4 = jax.random.split(key, 5)
     b = jax.random.randint(k1, (M,), 0, NB)
     bio = pp.is_io[b]
     rl = jnp.maximum(1, rlim.astype(jnp.int32))
 
-    # CLB target: uniform window around current pos, clamped to interior
-    d = jax.random.randint(k2, (M, 2), -rl, rl + 1)
-    cx = jnp.clip(pos[b, 0] + d[:, 0], 1, pp.nx)
-    cy = jnp.clip(pos[b, 1] + d[:, 1], 1, pp.ny)
+    # interior target: uniform window around the current position, but the
+    # column is drawn from the block's own type's column list (type
+    # legality by construction; rlim maps into column-index space so
+    # sparse-column types keep a comparable move radius)
+    tid = pp.type_id[b]
+    nc = pp.ncols[tid]
+    rl_col = jnp.maximum(1, (rl * nc) // jnp.int32(pp.nx))
+    u = jax.random.uniform(k2, (M,), minval=-1.0, maxval=1.0)
+    ci0 = pp.col_idx_of_x[tid, pos[b, 0]]
+    ci = jnp.clip(ci0 + jnp.round(u * rl_col.astype(jnp.float32))
+                  .astype(jnp.int32), 0, nc - 1)
+    cx = pp.col_list[tid, ci]
+    dy = jax.random.randint(k2b, (M,), -rl, rl + 1)
+    cy = jnp.clip(pos[b, 1] + dy, 1, pp.ny)
 
     # IO target: shift along the perimeter ring (ring distance ~ 2x
     # Manhattan distance for the same rlim), random subtile

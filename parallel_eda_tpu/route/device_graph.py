@@ -75,6 +75,29 @@ def delay_normalization(rr: RRGraph) -> float:
     return d if d > 0 else 1.0
 
 
+def wire_cost_floor(rr: RRGraph) -> tuple:
+    """Admissible per-manhattan-tile cost floors for A* lower bounds
+    (get_timing_driven_expected_cost semantics, route_timing.c:693 /
+    parallel_route/router.cxx:445): the cheapest wire's delay-normalised
+    congestion cost and cheapest wire in-edge delay, spread over the
+    longest segment length.  Shared by the device router's windowed A*
+    gate and the serial CPU baseline so both bounds embody the same
+    admissibility argument.
+
+    Returns (min_cong_per_tile, min_delay_per_tile, lmax)."""
+    wire = (rr.node_type == CHANX) | (rr.node_type == CHANY)
+    if not wire.any():
+        return 0.0, 0.0, 1
+    lmax = max(1, int((rr.xhigh - rr.xlow + rr.yhigh
+                       - rr.ylow)[wire].max()) + 1)
+    norm = delay_normalization(rr)
+    min_cong = float((rr.base_cost[wire] * norm).min()) / lmax
+    dst = np.repeat(np.arange(rr.num_nodes), np.diff(rr.in_row_ptr))
+    wd = rr.in_delay[wire[dst]]
+    min_delay = float(wd.min()) / lmax if len(wd) else 0.0
+    return min_cong, min_delay, lmax
+
+
 def to_device(rr: RRGraph) -> DeviceRRGraph:
     ell_src, ell_delay, valid = ell_from_csr(
         rr.in_row_ptr, rr.in_src, rr.in_delay)

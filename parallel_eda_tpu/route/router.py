@@ -37,8 +37,10 @@ import numpy as np
 from ..rr.graph import RRGraph
 from ..rr.terminals import NetTerminals
 from .device_graph import DeviceRRGraph, to_device
-from .search import (conflict_subset, overuse_summary, reroute_mask,
-                     route_batch_resident, wirelength_on_device)
+from .search import (build_windows, conflict_subset, overuse_summary,
+                     reroute_mask, route_batch_resident,
+                     route_batch_resident_win, window_sizes,
+                     wirelength_on_device)
 
 
 @dataclass
@@ -58,6 +60,13 @@ class RouterOpts:
     # after this iteration, rip up & reroute only illegal nets
     # (reference phase-two style refinement, …cxx:6238-6267)
     incremental_after: int = 1
+    # bb-windowed search (route.h:70-165 per-net boxes as gathered [Nbox]
+    # windows): on unless the boxes cover most of the device anyway
+    windowed: bool = True
+    # windows are skipped when max box holds > this fraction of all nodes
+    window_max_frac: float = 0.7
+    # or when the localized tables would exceed this many bytes
+    window_max_bytes: int = 4 << 30
     # per-run stats directory: writes iter_stats.txt / final_stats.txt in
     # the reference's schema (…cxx:5925-5935, 6344-6360); None = off
     stats_dir: Optional[str] = None
@@ -91,6 +100,9 @@ class RouteResult:
     # search effort counters (perf_t analogue, route.h:12-20)
     total_net_routes: int = 0
     total_relax_steps: int = 0
+    # nets whose bb was widened to the full device (left the windowed
+    # program; 0 on a healthy windowed run of a routable circuit)
+    widened_nets: int = 0
 
 
 def _color_schedule(idx: np.ndarray, conflict: np.ndarray):
@@ -194,6 +206,14 @@ class Router:
             self._s_node = NamedSharding(mesh, P(NODE))
             self._net_axis = mesh.shape[NET]
 
+    def _lb_scale(self):
+        """Admissible (congestion, delay) cost floors per manhattan tile
+        for the windowed A* gate (shared derivation: wire_cost_floor)."""
+        from .device_graph import wire_cost_floor
+
+        min_cong, min_delay, _ = wire_cost_floor(self.rr)
+        return (min_cong, min_delay)
+
     def _put_batch(self, a: np.ndarray):
         import jax
         x = jnp.asarray(a)
@@ -250,6 +270,32 @@ class Router:
         sinks_d = jnp.asarray(term.sinks.astype(np.int32))
         nsinks_np = term.num_sinks.astype(np.int64)
 
+        # --- bb-windowed search setup (VPR's per-net boxes as gathered
+        # fixed-size windows; search.py "Bounding-box-windowed search") ---
+        win = None
+        lb_scale = None
+        if opts.windowed:
+            # chunk over nets: window_sizes/build_windows hold an
+            # [chunk, N] membership intermediate — unchunked that is
+            # R x N and OOMs Titan-class graphs during setup
+            chunk = max(1, int(2e8) // max(1, N))
+            sizes = np.concatenate(
+                [np.asarray(window_sizes(dev, bb[lo:lo + chunk]))
+                 for lo in range(0, R, chunk)])
+            max_box = max(1, int(sizes.max()))
+            nbox = int(_pow2_at_least(max_box))
+            tbl_bytes = R * nbox * dev.max_in_degree * 9
+            if (max_box < opts.window_max_frac * N
+                    and tbl_bytes <= opts.window_max_bytes):
+                import jax
+
+                parts = [build_windows(dev, bb[lo:lo + chunk], nbox)
+                         for lo in range(0, R, chunk)]
+                win = (parts[0] if len(parts) == 1 else jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *parts))
+                lb_scale = jnp.asarray(self._lb_scale(), dtype=jnp.float32)
+        wide = np.zeros(R, dtype=bool)   # nets whose bb covers the device
+
         pres_fac = opts.initial_pres_fac
         result = RouteResult(False, 0, None, None, None, 0)
         n_over = -1                      # previous iteration's overuse
@@ -273,11 +319,17 @@ class Router:
                 groups = _color_schedule(idx, conflict[:len(idx), :len(idx)])
             else:
                 groups = [idx]
-            # fanout-homogeneous batches: fewer wasted waves
+            # fanout-homogeneous batches: fewer wasted waves; nets whose
+            # bb was widened to the full device can't use the windows and
+            # go through the global-space program in separate batches
             batches = []
             for g in groups:
-                g = g[np.argsort(-nsinks_np[g], kind="stable")]
-                batches.extend(g[lo:lo + B] for lo in range(0, len(g), B))
+                parts = ((g[~wide[g]], g[wide[g]]) if win is not None
+                         else (g,))
+                for gp in parts:
+                    gp = gp[np.argsort(-nsinks_np[gp], kind="stable")]
+                    batches.extend(gp[lo:lo + B]
+                                   for lo in range(0, len(gp), B))
 
             # one static wave cap for every batch: the wave loop is a
             # device while_loop that exits early once all sinks are done,
@@ -286,24 +338,47 @@ class Router:
             if crit_d is None:
                 crit_d = jnp.asarray(crit)
             for sel in batches:
+                if len(sel) == 0:
+                    continue
                 nsel = len(sel)
                 b_valid = np.zeros(B, dtype=bool)
                 b_valid[:nsel] = True
+                sel_d = self._put_batch(_pad_to(sel.astype(np.int32), B, 0))
+                valid_d = self._put_batch(b_valid)
                 # fused rip-up + route + commit + scatter-back, one device
                 # dispatch; each net is costed against the occupancy of
                 # *everyone else* (serial rip-up-one-net-at-a-time view,
                 # route_timing.c:399)
-                (paths, sink_delay, all_reached, bb, occ,
-                 steps) = route_batch_resident(
-                    dev, occ, acc, jnp.float32(pres_fac),
-                    paths, sink_delay, all_reached, bb,
-                    source_d, sinks_d, crit_d,
-                    self._put_batch(_pad_to(sel.astype(np.int32), B, 0)),
-                    self._put_batch(b_valid), full_bb,
-                    self.max_len, self.max_len, waves, opts.sink_group,
-                    self.mesh)
+                if win is not None and not wide[sel[0]]:
+                    (paths, sink_delay, all_reached, occ,
+                     steps) = route_batch_resident_win(
+                        dev, win, occ, acc, jnp.float32(pres_fac),
+                        paths, sink_delay, all_reached,
+                        source_d, sinks_d, crit_d, sel_d, valid_d,
+                        lb_scale,
+                        self.max_len, self.max_len, waves,
+                        opts.sink_group, self.mesh)
+                else:
+                    (paths, sink_delay, all_reached, bb, occ,
+                     steps) = route_batch_resident(
+                        dev, occ, acc, jnp.float32(pres_fac),
+                        paths, sink_delay, all_reached, bb,
+                        source_d, sinks_d, crit_d, sel_d, valid_d, full_bb,
+                        self.max_len, self.max_len, waves,
+                        opts.sink_group, self.mesh)
                 it_steps += int(steps)
                 result.total_net_routes += nsel
+
+            # a net that failed a sink gets the full device next time
+            # (place_and_route.c bb relaxation); it leaves the windowed
+            # program for good — its window no longer matches its bb
+            ar = np.asarray(all_reached)
+            newly_wide = ~ar & ~wide
+            if newly_wide.any():
+                wide |= newly_wide
+                result.widened_nets += int(newly_wide.sum())
+                bb = jnp.where(jnp.asarray(newly_wide)[:, None],
+                               full_bb[None, :], bb)
 
             n_over, over_total = (int(v) for v in overuse_summary(dev, occ))
             result.total_relax_steps += it_steps

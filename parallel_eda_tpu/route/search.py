@@ -32,6 +32,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from flax import struct
 from jax import lax
 
 from .device_graph import DeviceRRGraph
@@ -47,6 +48,16 @@ INF = jnp.inf
 JITTER_EPS = 0.02
 
 
+def congestion_cost_arrays(base, capacity, occ, acc, pres_fac):
+    """base * pres * acc from explicit arrays (any matching shapes) —
+    the ONE place the PathFinder present-cost formula lives; the global
+    and windowed programs both call it so they can never diverge."""
+    over = occ + 1 - capacity
+    pres = jnp.where(over > 0, 1.0 + over.astype(jnp.float32) * pres_fac,
+                     1.0)
+    return base * pres * acc
+
+
 def congestion_cost(dev: DeviceRRGraph, occ: jnp.ndarray, acc: jnp.ndarray,
                     pres_fac: jnp.ndarray) -> jnp.ndarray:
     """Per-node congestion cost  base * pres * acc.
@@ -59,9 +70,8 @@ def congestion_cost(dev: DeviceRRGraph, occ: jnp.ndarray, acc: jnp.ndarray,
     (vpr/SRC/route/route_common.c get_rr_cong_cost +
     parallel_route/congestion.h:177-193 update_costs semantics).
     """
-    over = occ + 1 - dev.capacity
-    pres = jnp.where(over > 0, 1.0 + over.astype(jnp.float32) * pres_fac, 1.0)
-    return dev.cong_base * pres * acc
+    return congestion_cost_arrays(dev.cong_base, dev.capacity, occ, acc,
+                                  pres_fac)
 
 
 def _relax(dev: DeviceRRGraph, cong_c: jnp.ndarray, crit_c: jnp.ndarray,
@@ -468,3 +478,351 @@ def wirelength_on_device(dev: DeviceRRGraph, paths):
     N = dev.num_nodes
     used = jnp.zeros(N + 1, bool).at[paths.ravel()].set(True)[:N]
     return jnp.sum(used & dev.is_wire, dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Bounding-box-windowed search.
+#
+# The reference bounds every sink search with a per-net bounding box
+# (route.h:70-165, SinkRouter::expand_node pruning) so the working set is
+# the box, not the device.  The dense-tensor analogue: gather each net's
+# in-box nodes into a fixed [Nbox] window with a LOCALIZED in-edge table,
+# and run the whole relaxation in window coordinates — [B, Nbox] state
+# instead of [B, N].  Memory and per-sweep work scale with box area, which
+# is what makes Titan-class graphs (N ~ 10^6-10^7) reachable at all
+# (VPR's boxes exist for exactly this reason).  Search runs local; rip-up,
+# commit, occupancy, and stored paths stay in global node ids.
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class WindowTables:
+    """Per-net localized search windows (device arrays, built once per
+    route() call; nets whose bb is later widened to the full device fall
+    back to the global-space program instead)."""
+    win_nodes: jnp.ndarray   # int32 [R, Nbox]  global node id (pad: N)
+    lsrc: jnp.ndarray        # int32 [R, Nbox, D] local src idx (pad: Nbox)
+    ldelay: jnp.ndarray      # f32   [R, Nbox, D] (pad: 0 — the sentinel
+    #   src index already yields INF dist; an inf pad would make 0*inf
+    #   NaN under crit=0 and poison the per-block min)
+    # node spans for the A* interval distance (a length-L wire is near a
+    # sink anywhere along its span, not just at xlow/ylow)
+    xl: jnp.ndarray          # int16 [R, Nbox]
+    xh: jnp.ndarray          # int16 [R, Nbox]
+    yl: jnp.ndarray          # int16 [R, Nbox]
+    yh: jnp.ndarray          # int16 [R, Nbox]
+
+    @property
+    def nbox(self) -> int:
+        return self.win_nodes.shape[1]
+
+
+@functools.partial(jax.jit, static_argnames=("Nbox",))
+def build_windows(dev: DeviceRRGraph, bbs, Nbox: int) -> WindowTables:
+    """bbs [R, 4] (xmin, xmax, ymin, ymax) -> localized window tables.
+
+    win_nodes rows are ascending (jnp.nonzero order), so global->local
+    translation is a searchsorted; an in-edge whose source lies outside
+    the window maps to the sentinel Nbox (masked in the relaxation —
+    exactly the reference's expand_node bb prune)."""
+    N = dev.num_nodes
+
+    def one(bb):
+        inside = ((dev.xhigh >= bb[0]) & (dev.xlow <= bb[1])
+                  & (dev.yhigh >= bb[2]) & (dev.ylow <= bb[3]))
+        return jnp.nonzero(inside, size=Nbox, fill_value=N)[0]
+
+    win = jax.vmap(one)(bbs).astype(jnp.int32)          # [R, Nbox]
+    wn_c = jnp.clip(win, 0, N - 1)
+    valid_node = win < N
+
+    gsrc = dev.ell_src[wn_c]                            # [R, Nbox, D]
+    gvalid = dev.ell_valid[wn_c] & valid_node[:, :, None]
+    pos = jax.vmap(jnp.searchsorted)(
+        win, gsrc.reshape(win.shape[0], -1)).reshape(gsrc.shape)
+    pos = jnp.clip(pos, 0, Nbox - 1).astype(jnp.int32)
+    hit = jnp.take_along_axis(
+        win[:, :, None], pos, axis=1) == gsrc
+    lsrc = jnp.where(gvalid & hit, pos, Nbox)
+    ldelay = jnp.where(lsrc < Nbox, dev.ell_delay[wn_c], 0.0)
+    return WindowTables(
+        win_nodes=win, lsrc=lsrc, ldelay=ldelay,
+        xl=dev.xlow[wn_c].astype(jnp.int16),
+        xh=dev.xhigh[wn_c].astype(jnp.int16),
+        yl=dev.ylow[wn_c].astype(jnp.int16),
+        yh=dev.yhigh[wn_c].astype(jnp.int16))
+
+
+@jax.jit
+def window_sizes(dev: DeviceRRGraph, bbs):
+    """Per-net in-box node count [R] (to size Nbox on the host)."""
+    def one(bb):
+        inside = ((dev.xhigh >= bb[0]) & (dev.xlow <= bb[1])
+                  & (dev.yhigh >= bb[2]) & (dev.ylow <= bb[3]))
+        return inside.sum(dtype=jnp.int32)
+    return jax.vmap(one)(bbs)
+
+
+def _relax_local(lsrc, ldelay, cong_c, crit_c, lb, seed, seed_tdel,
+                 sink_loc, remaining, max_steps: int):
+    """Seeded Bellman-Ford in window coordinates with A*-style pruning.
+
+    lsrc [B, Nbox, D] local in-edge table (Nbox = outside-window sentinel);
+    cong_c [B, Nbox] congestion term; crit_c [B, 1]; lb [B, Nbox]
+    admissible lower bound on remaining cost from each node to the nearest
+    remaining sink; seed [B, Nbox] tree mask; sink_loc [B, S] local sink
+    indices; remaining [B, S] sinks still wanted.
+
+    Pruning (get_timing_driven_expected_cost semantics, route_timing.c:693
+    / parallel_route/router.cxx:445-640): once some remaining sink has
+    distance bound_b, a relaxation that cannot beat it (cand + lb >=
+    bound) is suppressed; with admissible lb the final sink paths are
+    unaffected, and the loop's no-improvement exit fires much earlier."""
+    B, Nbox, D = lsrc.shape
+    DB = min(8, D)
+    nblocks = -(-D // DB)
+
+    dist0 = jnp.where(seed, 0.0, INF)
+    tdel0 = jnp.where(seed, seed_tdel, 0.0)
+    prev0 = jnp.full((B, Nbox), -1, jnp.int32)
+
+    sink_c = jnp.clip(sink_loc, 0, Nbox - 1)
+
+    def step(state):
+        dist, prev, tdel, _, it = state
+        dist_p = jnp.concatenate(
+            [dist, jnp.full((B, 1), INF, jnp.float32)], axis=1)
+        tdel_p = jnp.concatenate(
+            [tdel, jnp.zeros((B, 1), jnp.float32)], axis=1)
+
+        def blk(b, carry):
+            best0, bsrc0, btdel0 = carry
+            d0 = jnp.minimum(b * DB, D - DB)
+            s = lax.dynamic_slice(lsrc, (0, 0, d0), (B, Nbox, DB))
+            w = lax.dynamic_slice(ldelay, (0, 0, d0), (B, Nbox, DB))
+            sf = s.reshape(B, -1)
+            ds = jnp.take_along_axis(dist_p, sf, axis=1).reshape(s.shape)
+            cand3 = ds + crit_c[:, :, None] * w + cong_c[:, :, None]
+            bbest = jnp.min(cand3, axis=2)
+            slot = jnp.argmin(cand3, axis=2)
+            bsrc = jnp.take_along_axis(s, slot[:, :, None], axis=2)[:, :, 0]
+            w_pick = jnp.take_along_axis(w, slot[:, :, None],
+                                         axis=2)[:, :, 0]
+            btdel = jnp.take_along_axis(
+                tdel_p, bsrc, axis=1) + w_pick
+            better = bbest < best0
+            return (jnp.where(better, bbest, best0),
+                    jnp.where(better, bsrc, bsrc0),
+                    jnp.where(better, btdel, btdel0))
+
+        best, bsrc, btdel = lax.fori_loop(
+            0, nblocks, blk,
+            (jnp.full((B, Nbox), INF, jnp.float32),
+             jnp.full((B, Nbox), -1, jnp.int32),
+             jnp.zeros((B, Nbox), jnp.float32)))
+
+        # A* gate: the best distance any remaining sink has so far
+        sd = jnp.take_along_axis(dist, sink_c, axis=1)
+        bound = jnp.min(jnp.where(remaining, sd, INF), axis=1)  # [B]
+        gate = best + lb < bound[:, None]
+
+        improved = (best < dist) & gate
+        dist2 = jnp.where(improved, best, dist)
+        prev2 = jnp.where(improved, bsrc, prev)
+        tdel2 = jnp.where(improved, btdel, tdel)
+        return dist2, prev2, tdel2, jnp.any(improved), it + 1
+
+    def cond(state):
+        return state[3] & (state[4] < max_steps)
+
+    dist, prev, tdel, _, steps = lax.while_loop(
+        cond, step, (dist0, prev0, tdel0, jnp.bool_(True), jnp.int32(0)))
+    return dist, prev, tdel, steps
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_steps", "max_len", "num_waves", "group", "mesh"),
+    donate_argnames=("occ", "paths", "sink_delay", "all_reached"))
+def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
+                             occ, acc, pres_fac,
+                             paths, sink_delay, all_reached,
+                             source_all, sinks_all, crit_all,
+                             sel, valid, lb_scale,
+                             max_steps: int, max_len: int, num_waves: int,
+                             group: int, mesh=None):
+    """Windowed variant of route_batch_resident: same fused
+    rip-up/route/commit/scatter contract, but the search runs in [B, Nbox]
+    window coordinates from WindowTables.  lb_scale [2] = admissible
+    (congestion, delay) cost lower bound per manhattan tile for the A*
+    gate.  Nets whose bounding box was widened to the full device must go
+    through route_batch_resident instead (the Router routes them in
+    separate fallback batches).
+
+    Returns (paths, sink_delay, all_reached, occ, relax_steps)."""
+    N = dev.num_nodes
+    R = paths.shape[0]
+    B = sel.shape[0]
+    Nbox = win.nbox
+    S = sinks_all.shape[1]
+
+    b_paths = paths[sel]                                  # [B, S, L] global
+    b_src = source_all[sel]
+    b_sinks = sinks_all[sel]
+    b_crit = crit_all[sel]
+    wn = win.win_nodes[sel]                               # [B, Nbox]
+    lsrc = win.lsrc[sel]
+    ldelay = win.ldelay[sel]
+    xl = win.xl[sel].astype(jnp.int32)
+    xh = win.xh[sel].astype(jnp.int32)
+    yl = win.yl[sel].astype(jnp.int32)
+    yh = win.yh[sel].astype(jnp.int32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def c(x, *spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+        b_paths = c(b_paths, "net", None, None)
+        b_src = c(b_src, "net")
+        b_sinks = c(b_sinks, "net", None)
+        b_crit = c(b_crit, "net", None)
+        wn = c(wn, "net", None)
+        lsrc = c(lsrc, "net", None, None)
+        ldelay = c(ldelay, "net", None, None)
+        xl = c(xl, "net", None)
+        xh = c(xh, "net", None)
+        yl = c(yl, "net", None)
+        yh = c(yh, "net", None)
+
+    arangeB = jnp.arange(B)
+
+    # --- rip up in global space (identical to route_batch_resident) ---
+    nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
+    old_usage = usage_from_paths(b_paths, nodes_p1) & valid[:, None]
+    occ_rip = occ - jnp.sum(old_usage, axis=0, dtype=jnp.int32)
+    occ_view = occ[None, :] - old_usage.astype(jnp.int32)
+
+    # --- localize: congestion cost + terminals in window coordinates ---
+    wn_c = jnp.clip(wn, 0, N - 1)
+    node_ok = wn < N
+    occ_l = jnp.take_along_axis(occ_view, wn_c, axis=1)
+    cong_l = congestion_cost_arrays(dev.cong_base[wn_c], dev.capacity[wn_c],
+                                    occ_l, acc[wn_c], pres_fac)
+    # deterministic per-(net, global-node) jitter (same hash as the
+    # global-space program so both negotiate identically)
+    h = (sel.astype(jnp.int32)[:, None] * jnp.int32(2654435761 & 0x7FFFFFFF)
+         + wn_c * jnp.int32(40503))
+    jitter = 1.0 + JITTER_EPS * ((h & 0xFFFF).astype(jnp.float32) / 65536.0)
+    cong_l = jnp.where(node_ok, cong_l, INF)
+
+    def to_local(gids):
+        """Global node ids [B, K] -> local window indices (Nbox if absent)."""
+        p = jax.vmap(jnp.searchsorted)(wn, gids)
+        p = jnp.clip(p, 0, Nbox - 1).astype(jnp.int32)
+        ok = jnp.take_along_axis(wn, p, axis=1) == gids
+        return jnp.where(ok, p, Nbox), ok
+
+    src_loc, _ = to_local(b_src[:, None])
+    sink_loc, sink_in = to_local(jnp.clip(b_sinks, 0))
+    sink_loc = jnp.where(b_sinks >= 0, sink_loc, Nbox)
+
+    # --- incremental multi-sink wave loop in window coordinates ---
+    seed0 = (jnp.zeros((B, Nbox + 1), bool)
+             .at[arangeB[:, None], src_loc].set(True))[:, :Nbox]
+
+    def wave_body(state):
+        (seed, tdel_tree, remaining, lpaths, delay, reached_all,
+         relax_steps, wave) = state
+        crit_w = jnp.max(jnp.where(remaining, b_crit, 0.0), axis=1)
+        cong_c = (1.0 - crit_w)[:, None] * cong_l * jitter
+        # A* lower bound: manhattan tiles from the node's SPAN to the
+        # nearest remaining sink (interval distance — a length-L wire is
+        # adjacent to the sink anywhere along its span, so point distance
+        # from xlow/ylow would be inadmissible)
+        sc = jnp.clip(sink_loc, 0, Nbox - 1)
+        sx = jnp.take_along_axis(xl, sc, axis=1)
+        sy = jnp.take_along_axis(yl, sc, axis=1)
+        dx = jnp.maximum(jnp.maximum(xl[:, :, None] - sx[:, None, :],
+                                     sx[:, None, :] - xh[:, :, None]), 0)
+        dy = jnp.maximum(jnp.maximum(yl[:, :, None] - sy[:, None, :],
+                                     sy[:, None, :] - yh[:, :, None]), 0)
+        man = dx + dy                                       # [B, Nbox, S]
+        man = jnp.min(jnp.where(remaining[:, None, :], man, 1 << 28),
+                      axis=2).astype(jnp.float32)
+        lb = man * ((1.0 - crit_w)[:, None] * lb_scale[0]
+                    + crit_w[:, None] * lb_scale[1])
+        dist, prev, tdel, steps = _relax_local(
+            lsrc, ldelay, cong_c, crit_w[:, None], lb, seed, tdel_tree,
+            sink_loc, remaining, max_steps)
+        relax_steps = relax_steps + steps
+
+        sd = jnp.take_along_axis(
+            jnp.concatenate([dist, jnp.full((B, 1), INF)], axis=1),
+            sink_loc, axis=1)
+        score = jnp.where(remaining & jnp.isfinite(sd),
+                          sd - b_crit * 1e3, INF)
+        order = jnp.argsort(score, axis=1)[:, :group]
+        pick_valid = (jnp.take_along_axis(remaining, order, axis=1)
+                      & jnp.isfinite(jnp.take_along_axis(score, order,
+                                                         axis=1)))
+        pick_sink = jnp.where(
+            pick_valid, jnp.take_along_axis(sink_loc, order, axis=1), -1)
+
+        seg, seg_reached = _traceback(prev, seed, pick_sink, max_len)
+        ok = pick_valid & seg_reached
+
+        old = jnp.take_along_axis(lpaths, order[:, :, None], axis=1)
+        lpaths = _scatter_rows(lpaths, order,
+                               jnp.where(ok[:, :, None], seg, old))
+        d_new = jnp.take_along_axis(
+            jnp.concatenate([tdel, jnp.zeros((B, 1))], axis=1),
+            jnp.clip(pick_sink, 0), axis=1)
+        old_d = jnp.take_along_axis(delay, order, axis=1)
+        delay = _scatter_vals(delay, order, jnp.where(ok, d_new, old_d))
+        old_r = jnp.take_along_axis(reached_all, order, axis=1)
+        reached_all = _scatter_vals(reached_all, order, ok | old_r)
+        old_rem = jnp.take_along_axis(remaining, order, axis=1)
+        remaining = _scatter_vals(remaining, order, old_rem & ~ok)
+
+        flat = jnp.where(ok[:, :, None], seg, Nbox).reshape(B, -1)
+        newly = jnp.zeros((B, Nbox + 1), bool).at[
+            arangeB[:, None], flat].set(True)
+        tdel_tree = jnp.where(newly[:, :Nbox], tdel, tdel_tree)
+        seed = seed | newly[:, :Nbox]
+        return (seed, tdel_tree, remaining, lpaths, delay, reached_all,
+                relax_steps, wave + 1)
+
+    def wave_cond(state):
+        return jnp.any(state[2]) & (state[7] < num_waves)
+
+    # sinks that are outside their own window can never be reached: drop
+    # them from `remaining` so the wave loop doesn't spin on them (the
+    # Router widens the net's bb and retries via the fallback program)
+    remaining0 = (b_sinks >= 0) & sink_in
+    state0 = (seed0, jnp.zeros((B, Nbox), jnp.float32), remaining0,
+              jnp.full((B, S, max_len), Nbox, jnp.int32),
+              jnp.full((B, S), INF, jnp.float32),
+              jnp.zeros((B, S), bool), jnp.int32(0), jnp.int32(0))
+    (seed, _, _, lpaths, delay, reached_all, relax_steps,
+     _) = lax.while_loop(wave_cond, wave_body, state0)
+
+    # --- back to global ids ---
+    wn_p1 = jnp.concatenate(
+        [wn, jnp.full((B, 1), N, jnp.int32)], axis=1)     # local pad -> N
+    p = jnp.take_along_axis(
+        wn_p1, lpaths.reshape(B, -1), axis=1).reshape(lpaths.shape)
+    usage = (jnp.zeros((B, N + 1), bool)
+             .at[arangeB[:, None], jnp.where(seed, wn, N).reshape(B, -1)]
+             .set(True))[:, :N]
+    usage = usage & valid[:, None]
+    occ_new = occ_rip + jnp.sum(usage, axis=0, dtype=jnp.int32)
+
+    smask = b_sinks >= 0
+    ok = (reached_all | ~smask).all(axis=1)
+
+    sel_v = jnp.where(valid, sel, R).astype(jnp.int32)
+    paths = paths.at[sel_v].set(p, mode="drop")
+    sink_delay = sink_delay.at[sel_v].set(delay, mode="drop")
+    all_reached = all_reached.at[sel_v].set(ok, mode="drop")
+    return paths, sink_delay, all_reached, occ_new, relax_steps

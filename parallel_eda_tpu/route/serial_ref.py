@@ -78,13 +78,11 @@ class SerialRouter:
         self.base = rr.base_cost.astype(np.float64) * self.norm
         self.cap = rr.capacity.astype(np.int64)
         # A* lookahead (route_timing.c:693 get_timing_driven_expected_cost
-        # / parallel_route/router.cxx:445): cheapest-possible cost per tile
-        # of remaining manhattan distance = min wire base cost / longest
-        # segment length
-        wire = (rr.node_type == CHANX) | (rr.node_type == CHANY)
-        self.lmax = max(1, int((rr.xhigh - rr.xlow + rr.yhigh
-                                - rr.ylow)[wire].max()) + 1)
-        self.min_wire_cost = float(self.base[wire].min()) / self.lmax
+        # / parallel_route/router.cxx:445): same admissible per-tile cost
+        # floor the device router's windowed A* gate uses
+        from .device_graph import wire_cost_floor
+
+        self.min_wire_cost, _, self.lmax = wire_cost_floor(rr)
 
     def route(self, term: NetTerminals,
               crit: Optional[np.ndarray] = None) -> SerialRouteResult:

@@ -117,6 +117,16 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
         lo = hi
     seg_of_track[lo:] = num_seg - 1
 
+    def type_at(x: int, y: int):
+        """Block type on tile (x, y), or None (corner/empty).  Interior
+        columns may hold heterogeneous types (grid.col_types,
+        SetupGrid.c column assignment)."""
+        if 1 <= x <= nx and 1 <= y <= ny:
+            return arch.block_type(grid.interior_type_name(x))
+        if grid.is_io(x, y):
+            return arch.io_type
+        return None
+
     ntype: List[int] = []
     xlo: List[int] = []; ylo: List[int] = []
     xhi: List[int] = []; yhi: List[int] = []
@@ -136,11 +146,8 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
     # ---- block-pin nodes (SOURCE/SINK/OPIN/IPIN), per tile/subtile ----
     for x in range(nx + 2):
         for y in range(ny + 2):
-            if grid.is_clb(x, y):
-                bt = arch.clb_type
-            elif grid.is_io(x, y):
-                bt = arch.io_type
-            else:
+            bt = type_at(x, y)
+            if bt is None:
                 continue
             ncls = len(bt.pin_classes)
             for z in range(bt.capacity):
@@ -224,11 +231,8 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
     # ---- SOURCE->OPIN, IPIN->SINK (delayless) ----
     for x in range(nx + 2):
         for y in range(ny + 2):
-            if grid.is_clb(x, y):
-                bt = arch.clb_type
-            elif grid.is_io(x, y):
-                bt = arch.io_type
-            else:
+            bt = type_at(x, y)
+            if bt is None:
                 continue
             for z in range(bt.capacity):
                 for k, cls in enumerate(bt.pin_classes):
@@ -261,11 +265,8 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
 
     for x in range(nx + 2):
         for y in range(ny + 2):
-            if grid.is_clb(x, y):
-                bt = arch.clb_type
-            elif grid.is_io(x, y):
-                bt = arch.io_type
-            else:
+            bt = type_at(x, y)
+            if bt is None:
                 continue
             adj = adjacent_channels(x, y)
             for z in range(bt.capacity):

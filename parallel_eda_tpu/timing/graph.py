@@ -31,8 +31,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..netlist.netlist import (LogicalNetlist, PRIM_FF, PRIM_INPAD,
-                               PRIM_LUT, PRIM_OUTPAD)
+from ..netlist.netlist import (LogicalNetlist, PRIM_FF, PRIM_HARD,
+                               PRIM_INPAD, PRIM_LUT, PRIM_OUTPAD)
 from ..netlist.packed import PackedNetlist
 from ..rr.terminals import NetTerminals
 
@@ -121,7 +121,9 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
             out_tnode[i] = new_tnode(i)
         elif p.kind == PRIM_LUT:
             out_tnode[i] = new_tnode(i)
-        elif p.kind == PRIM_FF:
+        elif p.kind in (PRIM_FF, PRIM_HARD):
+            # hard macros are registered (RAM/DSP): input setup endpoint,
+            # clk-to-q launch point — FF semantics at the block's timing
             in_tnode[i] = new_tnode(i)
             out_tnode[i] = new_tnode(i)
         elif p.kind == PRIM_OUTPAD:
@@ -134,7 +136,7 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
         bt = pnl.block_type(block_of_prim[i])
         if p.kind == PRIM_INPAD:
             arrival0[out_tnode[i]] = 0.0
-        elif p.kind == PRIM_FF:
+        elif p.kind in (PRIM_FF, PRIM_HARD):
             arrival0[out_tnode[i]] = bt.T_clk_to_q
             is_endpoint[in_tnode[i]] = True
         elif p.kind == PRIM_OUTPAD:
@@ -148,13 +150,13 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
         bt = pnl.block_type(block_of_prim[i])
         if p.kind == PRIM_LUT:
             dst, extra = out_tnode[i], bt.T_comb
-        elif p.kind == PRIM_FF:
+        elif p.kind in (PRIM_FF, PRIM_HARD):
             dst, extra = in_tnode[i], bt.T_setup
         else:                                       # outpad
             dst, extra = in_tnode[i], 0.0
         for n in p.inputs:
-            if n in clocks:
-                continue                            # ideal clock network
+            if n is None or n in clocks:
+                continue          # unconnected port / ideal clock network
             dp = nl.net_driver[n]
             src = out_tnode[dp]
             const, ridx = extra, -1

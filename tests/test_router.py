@@ -91,3 +91,33 @@ def test_route_timing_criticality_path():
     d0 = res0.sink_delay[ns_mask]
     d1 = res1.sink_delay[ns_mask]
     assert d1.sum() <= d0.sum() * 1.05
+
+
+def test_route_windowed_matches_global():
+    # the bb-windowed program and the global-space program must both
+    # produce legal routings of the same quality class; windowed is the
+    # default, global is the wide-net fallback (search.py windowed docs)
+    _, _, _, _, rr, term = _flow(num_luts=30, chan_width=10, seed=9)
+    rw = Router(rr, RouterOpts(batch_size=32, windowed=True)).route(term)
+    rg = Router(rr, RouterOpts(batch_size=32, windowed=False)).route(term)
+    assert rw.success and rg.success
+    # the windowed program must actually route the nets: if it silently
+    # failed every net, each would be widened to the full device and
+    # handed to the global fallback
+    assert rw.widened_nets == 0, \
+        f"{rw.widened_nets} nets fell back to the global program"
+    check_route(rr, term, rw.paths, occ=rw.occ)
+    check_route(rr, term, rg.paths, occ=rg.occ)
+    # same cost model + same jitter hash => equal quality class (allow a
+    # small drift from A*-pruned ties)
+    assert abs(rw.wirelength - rg.wirelength) <= 0.1 * rg.wirelength
+    # the A* gate must do strictly less relaxation work
+    assert rw.total_relax_steps <= rg.total_relax_steps
+
+
+def test_route_windowed_deterministic():
+    _, _, _, _, rr, term = _flow(num_luts=25, chan_width=10, seed=11)
+    a = Router(rr, RouterOpts(batch_size=16)).route(term)
+    b = Router(rr, RouterOpts(batch_size=16)).route(term)
+    assert a.success and b.success
+    assert np.array_equal(a.paths, b.paths)
