@@ -68,17 +68,89 @@ def build_parser() -> argparse.ArgumentParser:
                    "here (the reference's <circuit>_stats_N/ files)")
     p.add_argument("--no_timing", action="store_true",
                    help="congestion-driven only (NO_TIMING algorithm)")
+    p.add_argument("--sdc", default="",
+                   help="SDC constraints file (create_clock subset, "
+                   "read_sdc.c equivalent); enables multi-clock slack")
+    p.add_argument("--draw", default="",
+                   help="write placement.svg / routing.svg views here "
+                   "(the graphics.c/draw.c X11 viewer's batch analogue)")
     # placer opts
     p.add_argument("--moves_per_step", type=int, default=256)
     p.add_argument("--inner_num", type=float, default=1.0)
     p.add_argument("--timing_tradeoff", type=float, default=0.5,
                    help="timing vs wirelength weight in placement "
                    "(0 = pure wirelength)")
+    p.add_argument("--settings_file", default="",
+                   help="file of 'flag value' lines used as defaults "
+                   "(base/read_settings.c); explicit CLI flags win")
     return p
 
 
+def apply_settings_file(argv, path: str):
+    """Prepend the settings file's options so explicit CLI flags override
+    them (read_settings.c semantics: file supplies defaults)."""
+    file_args = []
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            toks = line.split()
+            flag = toks[0] if toks[0].startswith("--") else "--" + toks[0]
+            file_args.append(flag)
+            file_args.extend(toks[1:])
+    return file_args + list(argv)
+
+
+def check_options(args) -> None:
+    """Option conflict checking (base/CheckOptions.c / CheckSetup.c):
+    reject combinations the flow cannot honor rather than misbehaving."""
+    errs = []
+    if args.binary_search and args.route_chan_width:
+        errs.append("--binary_search ignores --route_chan_width; give "
+                    "only one")
+    if args.binary_search and not args.route:
+        errs.append("--binary_search requires routing (drop --no_route)")
+    if args.place_file and args.no_place:
+        errs.append("--place_file already skips placement; drop "
+                    "--no_place")
+    if args.mesh:
+        try:
+            net_ax, node_ax = (int(v) for v in args.mesh.lower().split("x"))
+        except ValueError:
+            errs.append(f"--mesh '{args.mesh}' is not NETxNODE")
+        else:
+            if net_ax < 1 or node_ax < 1:
+                errs.append("--mesh axes must be >= 1")
+    if args.sink_group < 1:
+        errs.append("--sink_group must be >= 1")
+    if args.batch_size < 1:
+        errs.append("--batch_size must be >= 1")
+    if args.timing_tradeoff < 0 or args.timing_tradeoff > 1:
+        errs.append("--timing_tradeoff must be in [0, 1]")
+    if args.sdc and args.no_timing:
+        errs.append("--sdc needs timing analysis; drop --no_timing")
+    if errs:
+        raise SystemExit("option errors:\n  " + "\n  ".join(errs))
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        try:
+            if a == "--settings_file":
+                if i + 1 >= len(argv):
+                    raise SystemExit("--settings_file expects a path")
+                argv = apply_settings_file(argv, argv[i + 1])
+                break
+            if a.startswith("--settings_file="):
+                argv = apply_settings_file(argv, a.split("=", 1)[1])
+                break
+        except OSError as e:
+            raise SystemExit(f"--settings_file: {e}")
     args = build_parser().parse_args(argv)
+    check_options(args)
 
     from .arch.builtin import k6_n10_arch, minimal_arch
     from .flow import (FlowResult, binary_search_route, prepare, run_place,
@@ -113,6 +185,11 @@ def main(argv=None) -> int:
         print(f"packed netlist read from {args.net_file}")
     flow = prepare(nl, arch, chan_width, seed=args.seed,
                    bb_factor=args.bb_factor, pnl=pnl)
+    if args.sdc:
+        from .timing.sdc import read_sdc
+        flow.sdc = read_sdc(args.sdc)
+        per = {c: p / 1e-9 for c, p in flow.sdc.clock_periods.items()}
+        print(f"sdc: clock periods (ns) {per}")
     print(f"packed: {flow.pnl.stats()}")
     print(f"grid: {flow.grid.nx} x {flow.grid.ny} "
           f"(pack {flow.times['pack']:.2f}s, "
@@ -173,6 +250,24 @@ def main(argv=None) -> int:
               f"{flow.times['route']:.2f}s")
         if not args.no_timing:
             print(f"critical path: {flow.crit_path_delay * 1e9:.3f} ns")
+            if flow.sdc is not None:
+                ws = flow.analyzer.worst_slack
+                print(f"worst slack: {ws * 1e9:.3f} ns "
+                      f"({'MET' if ws >= 0 else 'VIOLATED'})")
+
+    if args.draw:
+        import os
+
+        from .draw import write_placement_svg, write_routing_svg
+        os.makedirs(args.draw, exist_ok=True)
+        p1 = os.path.join(args.draw, "placement.svg")
+        write_placement_svg(flow, p1)
+        drawn = [p1]
+        if flow.route is not None and flow.route.occ is not None:
+            p2 = os.path.join(args.draw, "routing.svg")
+            write_routing_svg(flow, p2)
+            drawn.append(p2)
+        print("drew " + " ".join(drawn))
 
     paths = save_artifacts(flow, args.out_dir)
     print("wrote " + " ".join(sorted(paths.values())))

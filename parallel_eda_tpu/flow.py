@@ -48,6 +48,7 @@ class FlowResult:
     place_stats: Optional[PlaceStats] = None
     bb_factor: int = 3
     times: dict = field(default_factory=dict)   # stage -> seconds
+    sdc: Optional[object] = None    # timing.sdc.SdcConstraints (or None)
 
     @property
     def crit_path_delay(self) -> float:
@@ -277,7 +278,7 @@ def run_route(flow: FlowResult, opts: Optional[RouterOpts] = None,
         if flow.tg is None:
             flow.tg = build_timing_graph(flow.nl, flow.pnl, flow.term)
         if flow.analyzer is None:
-            flow.analyzer = TimingAnalyzer(flow.tg)
+            flow.analyzer = TimingAnalyzer(flow.tg, sdc=flow.sdc)
     router = Router(flow.rr, opts, mesh=mesh)
     t0 = time.time()
     cb = flow.analyzer.timing_cb if timing_driven else None

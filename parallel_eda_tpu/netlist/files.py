@@ -3,9 +3,10 @@
 Equivalent of the reference's readers/writers (vpr/SRC/base/read_netlist.c,
 read_place.c, route/route_common.c print_route).  These files are the
 checkpoint/resume surface of the flow (SURVEY.md §5.4): any stage can be
-restarted from them.  Formats follow VPR 7's text layouts closely enough to
-be diffable by eye; the .net file uses a compact JSON encoding rather than
-VPR7's XML (same information content).
+restarted from them.  Formats follow VPR 7's text layouts: .place and
+.route match the reference's printers line-for-line in structure, and the
+.net file is VPR7-style packed-netlist XML (read_netlist.c) with
+positional class-port names; the legacy JSON .net form is still read.
 """
 
 from __future__ import annotations
@@ -15,31 +16,105 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..arch.model import Arch
+from ..arch.model import Arch, PIN_CLASS_DRIVER
 from .packed import Block, ClbNet, NetPin, PackedNetlist
 
 
 # ---------------------------------------------------------------- .net ----
+#
+# VPR7-style packed-netlist XML (vpr/SRC/base/read_netlist.c /
+# output_netlist.c):  a top <block name instance="FPGA_packed_netlist[0]">
+# with <inputs>/<outputs>/<clocks> lists, one child <block> per cluster
+# with instance="<type>[<i>]" and per-pin-class <port> elements whose
+# tokens are net names or "open".  Our pin classes are positional, so
+# ports are named "c<k>" by class index (VPR names them from the arch's
+# pb_type ports; the structure and token layout match).
 
 def write_net_file(pnl: PackedNetlist, path: str) -> None:
-    doc = {
-        "name": pnl.name,
-        "blocks": [
-            {"name": b.name, "type": b.type_name,
-             "pin_nets": b.pin_nets, "prims": b.prims}
-            for b in pnl.blocks
-        ],
-        "nets": [
-            {"name": n.name, "global": n.is_global} for n in pnl.nets
-        ],
-    }
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=1)
+    import xml.etree.ElementTree as ET
+
+    root = ET.Element("block", name=pnl.name,
+                      instance="FPGA_packed_netlist[0]")
+    ins, outs, clks = [], [], []
+    for bi, b in enumerate(pnl.blocks):
+        bt = pnl.block_type(bi)
+        if bt.is_io:
+            # pin 0 = receiver (outpad), pin 1 = driver (inpad)
+            if b.pin_nets[1] >= 0:
+                ins.append(pnl.nets[b.pin_nets[1]].name)
+            if b.pin_nets[0] >= 0:
+                outs.append(pnl.nets[b.pin_nets[0]].name)
+    clks = [n.name for n in pnl.nets if n.is_global]
+    ET.SubElement(root, "inputs").text = " ".join(ins)
+    ET.SubElement(root, "outputs").text = " ".join(outs)
+    ET.SubElement(root, "clocks").text = " ".join(clks)
+    # net-index order, so a reloaded netlist keeps the exact numbering a
+    # paired .route file refers to ('Net {i}' rows, print_route); VPR7
+    # derives this from traversal order, which port-scan order would not
+    # reproduce once globals exist
+    ET.SubElement(root, "nets").text = " ".join(n.name for n in pnl.nets)
+
+    for bi, b in enumerate(pnl.blocks):
+        bt = pnl.block_type(bi)
+        eb = ET.SubElement(root, "block", name=b.name,
+                           instance=f"{b.type_name}[{bi}]")
+        if b.prims:
+            eb.set("prims", " ".join(str(p) for p in b.prims))
+        e_in = ET.SubElement(eb, "inputs")
+        e_out = ET.SubElement(eb, "outputs")
+        e_clk = ET.SubElement(eb, "clocks")
+        for k, cls in enumerate(bt.pin_classes):
+            toks = []
+            for p in cls.pins:
+                ni = b.pin_nets[p]
+                toks.append(pnl.nets[ni].name if ni >= 0 else "open")
+            parent = (e_clk if cls.is_clock else
+                      e_out if cls.direction == PIN_CLASS_DRIVER else e_in)
+            port = ET.SubElement(parent, "port", name=f"c{k}")
+            port.text = " ".join(toks)
+    ET.indent(root)
+    ET.ElementTree(root).write(path)
 
 
 def read_net_file(path: str, arch: Arch) -> PackedNetlist:
+    """Read a packed netlist: VPR7-style XML (or the legacy JSON form)."""
     with open(path) as f:
-        doc = json.load(f)
+        text = f.read()
+    if text.lstrip().startswith("{"):
+        return _read_net_json(text, arch)
+    import xml.etree.ElementTree as ET
+
+    root = ET.fromstring(text)
+    pnl = PackedNetlist(name=root.get("name", "top"))
+    globals_ = set((root.findtext("clocks") or "").split())
+    # restore the writer's net numbering when present (route-file pairing)
+    for name in (root.findtext("nets") or "").split():
+        pnl.add_net(name, is_global=name in globals_)
+    for g in sorted(globals_):
+        pnl.add_net(g, is_global=True)
+    for eb in root.findall("block"):
+        inst = eb.get("instance", "")
+        tname = inst.split("[", 1)[0]
+        bt = arch.block_type(tname)
+        pin_nets = [-1] * bt.num_pins
+        ports = {p.get("name"): (p.text or "") for sec in eb
+                 for p in sec.findall("port")}
+        for k, cls in enumerate(bt.pin_classes):
+            toks = ports.get(f"c{k}", "").split()
+            for j, p in enumerate(cls.pins):
+                if j < len(toks) and toks[j] != "open":
+                    pin_nets[p] = pnl.add_net(
+                        toks[j], is_global=toks[j] in globals_)
+        prims = [int(v) for v in (eb.get("prims") or "").split()]
+        pnl.blocks.append(Block(name=eb.get("name"), type_name=tname,
+                                pin_nets=pin_nets, prims=prims))
+    pnl.bind_types(arch)
+    pnl.connect()
+    return pnl
+
+
+def _read_net_json(text: str, arch: Arch) -> PackedNetlist:
+    doc = json.loads(text)
     pnl = PackedNetlist(name=doc["name"])
     for n in doc["nets"]:
         pnl.add_net(n["name"], is_global=n["global"])

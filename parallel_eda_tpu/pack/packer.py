@@ -60,7 +60,60 @@ def _form_bles(nl: LogicalNetlist) -> List[_BLE]:
     return bles
 
 
-def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
+def _ble_criticalities(bles: List[_BLE], producers: Dict[str, int]):
+    """Unit-delay slack analysis over the BLE graph (the packer-time
+    timing estimate AAPack uses before any placement exists,
+    pack/cluster.c timing-driven gain): returns crit [nble] in [0, 1],
+    1 = on the longest combinational path.  FF boundaries cut paths (a
+    registered BLE output launches a new path)."""
+    nble = len(bles)
+    # combinational edges u -> v: v consumes u's output and u is NOT
+    # registered (a FF output starts a fresh path)
+    succ: List[List[int]] = [[] for _ in range(nble)]
+    for v, b in enumerate(bles):
+        for n in b.inputs:
+            u = producers.get(n)
+            if u is not None and bles[u].ff is None:
+                succ[u].append(v)
+    arr = [0] * nble
+    # longest path via repeated relaxation (DAG; nble passes worst case,
+    # but depth passes suffice — iterate until fixpoint)
+    changed = True
+    guard = 0
+    while changed and guard <= nble:
+        changed = False
+        guard += 1
+        for u in range(nble):
+            for v in succ[u]:
+                if arr[v] < arr[u] + 1:
+                    arr[v] = arr[u] + 1
+                    changed = True
+    req_from = [0] * nble
+    changed = True
+    guard = 0
+    while changed and guard <= nble:
+        changed = False
+        guard += 1
+        for u in range(nble):
+            for v in succ[u]:
+                if req_from[u] < req_from[v] + 1:
+                    req_from[u] = req_from[v] + 1
+                    changed = True
+    dmax = max((arr[v] + req_from[v] for v in range(nble)), default=0)
+    if dmax == 0:
+        return [0.0] * nble
+    return [(arr[v] + req_from[v]) / dmax for v in range(nble)]
+
+
+def pack_netlist(nl: LogicalNetlist, arch: Arch,
+                 timing_driven: bool = True,
+                 alpha: float = 0.75) -> PackedNetlist:
+    """AAPack-style seed-grow clustering (pack/cluster.c:232
+    do_clustering).  timing_driven weighs the attraction toward
+    critical-path neighbours (VPR's  gain = alpha * timing_gain +
+    (1 - alpha) * connection_gain) and seeds clusters with the most
+    critical unclustered BLE, so long combinational chains pack into the
+    same CLB and ride the fast intra-cluster interconnect."""
     N, I = arch.N, arch.I
     clocks = set(nl.clocks)
     bles = _form_bles(nl)
@@ -75,22 +128,30 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
             if n not in clocks:
                 consumers.setdefault(n, []).append(bi)
 
+    crit = (_ble_criticalities(bles, producers)
+            if timing_driven else [0.0] * nble)
+
     # adjacency weight = number of shared nets between BLE pairs
     degree = [len(b.inputs) + len(consumers.get(b.output, [])) for b in bles]
     unclustered = set(range(nble))
     clusters: List[List[int]] = []
 
-    def attraction(cluster_bles: Set[int], cand: int) -> int:
-        score = 0
+    def attraction(cluster_bles: Set[int], cand: int) -> float:
+        conn = 0
+        tgain = 0.0
         b = bles[cand]
         for n in b.inputs:
             p = producers.get(n)
             if p is not None and p in cluster_bles:
-                score += 1
+                conn += 1
+                tgain = max(tgain, min(crit[p], crit[cand]))
         for c in consumers.get(b.output, []):
             if c in cluster_bles:
-                score += 1
-        return score
+                conn += 1
+                tgain = max(tgain, min(crit[cand], crit[c]))
+        if not timing_driven:
+            return float(conn)
+        return alpha * tgain * 10.0 + (1.0 - alpha) * conn
 
     def cluster_inputs(members: Set[int], cand: Optional[int] = None) -> int:
         mem = set(members)
@@ -105,7 +166,10 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
         return len(ext)
 
     while unclustered:
-        seed = max(unclustered, key=lambda b: (degree[b], -b))
+        # seed with the most critical unclustered BLE (cluster.c
+        # get_seed_logical_molecule_with_most_critical_inputs), degree as
+        # the tiebreak (and the whole criterion when not timing-driven)
+        seed = max(unclustered, key=lambda b: (crit[b], degree[b], -b))
         members: Set[int] = {seed}
         unclustered.remove(seed)
         clk = bles[seed].clock
@@ -121,7 +185,7 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch) -> PackedNetlist:
                 for c in consumers.get(b.output, []):
                     if c in unclustered:
                         cands.add(c)
-            best, best_score = None, -1
+            best, best_score = None, -1.0
             for c in sorted(cands):
                 bc = bles[c]
                 if bc.clock is not None and clk is not None and bc.clock != clk:

@@ -120,7 +120,8 @@ class SerialRouter:
             over = np.maximum(0, occ - self.cap)
             n_over = int((over > 0).sum())
             res.stats.append({"iteration": it, "overused": n_over,
-                              "heap_pops": pops})
+                              "heap_pops": pops,
+                              "rerouted": len(reroute)})
             if n_over == 0:
                 res.success = True
                 res.iterations = it

@@ -83,6 +83,10 @@ class TimingGraph:
     num_route_slots: int       # R * Smax (size of the routed-delay vector)
     # diagnostics: tnode -> primitive index
     tnode_prim: np.ndarray
+    # multi-clock (SDC): endpoint -> clock-domain index into ``domains``
+    # (-1 = unclocked endpoint, e.g. outpads: constrained by the default)
+    endpoint_domain: np.ndarray = None   # int32 [T]
+    domains: list = None                 # domain index -> clock net name
 
 
 def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
@@ -132,6 +136,10 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
 
     arrival0 = np.full(T, -np.inf, dtype=np.float32)
     is_endpoint = np.zeros(T, dtype=bool)
+    # clock domains (SDC multi-clock): one per distinct clock net
+    domains = sorted(clocks)
+    dom_of = {c: k for k, c in enumerate(domains)}
+    endpoint_domain = np.full(T, -1, dtype=np.int32)
     for i, p in enumerate(nl.primitives):
         bt = pnl.block_type(block_of_prim[i])
         if p.kind == PRIM_INPAD:
@@ -139,6 +147,8 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
         elif p.kind in (PRIM_FF, PRIM_HARD):
             arrival0[out_tnode[i]] = bt.T_clk_to_q
             is_endpoint[in_tnode[i]] = True
+            if p.clock is not None:
+                endpoint_domain[in_tnode[i]] = dom_of[p.clock]
         elif p.kind == PRIM_OUTPAD:
             is_endpoint[in_tnode[i]] = True
 
@@ -216,4 +226,5 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
         arrival0=arrival0, is_endpoint=is_endpoint,
         num_route_slots=R * Smax,
         tnode_prim=np.array(tnode_prim, dtype=np.int32),
+        endpoint_domain=endpoint_domain, domains=domains,
     )

@@ -1,0 +1,123 @@
+"""SDC timing-constraint parser (subset).
+
+Equivalent of the reference's SDC reader (vpr/SRC/timing/read_sdc.c, regex
+via slre.c): the subset that drives its analysis —
+
+  create_clock -period <ns> [-name <name>] [<ports> | [get_ports {...}]]
+  set_clock_groups -exclusive -group {...} -group {...}   (parsed, noted)
+  set_false_path ...                                       (ignored rows)
+
+Periods are given in ns (VPR convention) and stored in seconds.  When no
+SDC is supplied the flow behaves as before: a single ideal clock whose
+required time is the critical-path delay itself (path_delay.c behavior
+when read_sdc finds no file).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+NS = 1e-9
+
+
+@dataclass
+class SdcConstraints:
+    # clock (net/port name) -> period in seconds
+    clock_periods: Dict[str, float] = field(default_factory=dict)
+    # clocks declared with -name only (virtual clocks)
+    virtual_clocks: Dict[str, float] = field(default_factory=dict)
+    # exclusive clock groups (set_clock_groups -exclusive)
+    exclusive_groups: List[List[str]] = field(default_factory=list)
+
+    @property
+    def default_period(self) -> Optional[float]:
+        """Fallback period for unconstrained domains: the slowest declared
+        clock (conservative)."""
+        vals = list(self.clock_periods.values()) + \
+            list(self.virtual_clocks.values())
+        return max(vals) if vals else None
+
+    def period_of(self, clock_name: str) -> Optional[float]:
+        if clock_name in self.clock_periods:
+            return self.clock_periods[clock_name]
+        if clock_name in self.virtual_clocks:
+            return self.virtual_clocks[clock_name]
+        return self.default_period
+
+
+def _tokens(text: str) -> List[List[str]]:
+    """Logical SDC commands -> token lists; unwraps [get_ports {...}],
+    braces and brackets (the slre-regex equivalent, read_sdc.c)."""
+    cmds: List[List[str]] = []
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        for drop in ("[get_ports", "[get_clocks", "{", "}", "[", "]"):
+            line = line.replace(drop, " ")
+        toks = [t for t in line.split() if t]
+        if toks:
+            cmds.append(toks)
+    return cmds
+
+
+def parse_sdc(text: str) -> SdcConstraints:
+    sdc = SdcConstraints()
+    for toks in _tokens(text):
+        cmd = toks[0]
+        if cmd == "create_clock":
+            period = None
+            cname = None
+            ports: List[str] = []
+            i = 1
+            while i < len(toks):
+                if toks[i] == "-period":
+                    period = float(toks[i + 1]) * NS
+                    i += 2
+                elif toks[i] == "-name":
+                    cname = toks[i + 1]
+                    i += 2
+                elif toks[i].startswith("-"):
+                    i += 2          # unknown option + value
+                else:
+                    ports.append(toks[i])
+                    i += 1
+            if period is None:
+                raise ValueError("create_clock without -period")
+            if ports:
+                for p in ports:
+                    sdc.clock_periods[p] = period
+            elif cname is not None:
+                sdc.virtual_clocks[cname] = period
+            else:
+                raise ValueError("create_clock needs -name or ports")
+        elif cmd == "set_clock_groups":
+            group: List[str] = []
+            groups: List[List[str]] = []
+            i = 1
+            while i < len(toks):
+                if toks[i] == "-group":
+                    if group:
+                        groups.append(group)
+                    group = []
+                    i += 1
+                elif toks[i].startswith("-"):
+                    i += 1
+                else:
+                    group.append(toks[i])
+                    i += 1
+            if group:
+                groups.append(group)
+            sdc.exclusive_groups.extend(groups)
+        elif cmd in ("set_false_path", "set_input_delay",
+                     "set_output_delay", "set_multicycle_path"):
+            continue            # accepted, not modeled (subset)
+        else:
+            raise ValueError(f"unsupported SDC command: {cmd}")
+    return sdc
+
+
+def read_sdc(path: str) -> SdcConstraints:
+    with open(path) as f:
+        return parse_sdc(f.read())

@@ -55,13 +55,23 @@ def to_device(tg: TimingGraph) -> DeviceTimingGraph:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "crit_exp", "max_crit"))
+@functools.partial(jax.jit, static_argnames=("depth", "crit_exp",
+                                             "max_crit", "use_sdc"))
 def sta_sweep(dev: DeviceTimingGraph, route_delay: jnp.ndarray,
-              depth: int, crit_exp: float = 1.0, max_crit: float = 0.99
-              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+              depth: int, crit_exp: float = 1.0, max_crit: float = 0.99,
+              req_seed: jnp.ndarray = None, use_sdc: bool = False
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                         jnp.ndarray]:
     """route_delay: flat [R*Smax + 1] routed per-connection delays with a
-    trailing 0.0 slot so ridx == -1 gathers a zero.  Returns
-    (crit_flat [R*Smax], Dmax scalar, arrival [T])."""
+    trailing 0.0 slot so ridx == -1 gathers a zero.
+
+    Single-clock mode (use_sdc=False, path_delay.c default): endpoint
+    required time = the critical-path delay itself.  SDC mode: req_seed
+    [T] carries each endpoint's clock-domain period (read_sdc.c
+    constraint application); slacks may go negative and criticality
+    saturates at max_crit.
+
+    Returns (crit_flat [R*Smax], Dmax, worst_slack, arrival [T])."""
     rd = jnp.where(jnp.isfinite(route_delay), route_delay, 0.0)
 
     d_in = dev.in_const + rd[dev.in_ridx]          # [T, D] (-1 -> last slot)
@@ -77,20 +87,49 @@ def sta_sweep(dev: DeviceTimingGraph, route_delay: jnp.ndarray,
     dmax = jnp.max(jnp.where(dev.is_endpoint, arr, NEG))
     dmax = jnp.where(jnp.isfinite(dmax), dmax, 0.0)
 
-    req0 = jnp.where(dev.is_endpoint, dmax, jnp.inf)
+    if use_sdc:
+        req0 = jnp.where(dev.is_endpoint, req_seed, jnp.inf)
+        # each tnode's slack is normalised by the period of the DOMAIN
+        # whose endpoint dominates its required time (per-constraint
+        # analysis, read_sdc.c application): a fast clock's 95%-margin
+        # connection must not saturate just because a slow clock exists
+        per0 = jnp.where(dev.is_endpoint & jnp.isfinite(req_seed),
+                         req_seed, 0.0)
 
-    def bwd(_, req):
-        cand = req[dev.out_dst] - d_out
-        cand = jnp.where(dev.out_valid, cand, jnp.inf)
-        return jnp.minimum(req0, cand.min(axis=1))
+        def bwd(_, st):
+            req, per = st
+            cand = jnp.where(dev.out_valid, req[dev.out_dst] - d_out,
+                             jnp.inf)
+            cper = jnp.where(dev.out_valid, per[dev.out_dst], 0.0)
+            cand_all = jnp.concatenate([cand, req0[:, None]], axis=1)
+            per_all = jnp.concatenate([cper, per0[:, None]], axis=1)
+            j = jnp.argmin(cand_all, axis=1)
+            return (jnp.take_along_axis(cand_all, j[:, None],
+                                        axis=1)[:, 0],
+                    jnp.take_along_axis(per_all, j[:, None],
+                                        axis=1)[:, 0])
 
-    req = jax.lax.fori_loop(0, depth, bwd, req0)
+        req, per = jax.lax.fori_loop(0, depth, bwd, (req0, per0))
+        denom = jnp.where(per > 0, per, jnp.maximum(dmax, 1e-30))[:, None]
+    else:
+        req0 = jnp.where(dev.is_endpoint, dmax, jnp.inf)
+
+        def bwd(_, req):
+            cand = req[dev.out_dst] - d_out
+            cand = jnp.where(dev.out_valid, cand, jnp.inf)
+            return jnp.minimum(req0, cand.min(axis=1))
+
+        req = jax.lax.fori_loop(0, depth, bwd, req0)
+        denom = jnp.maximum(dmax, 1e-30)
+
+    worst = jnp.min(jnp.where(dev.is_endpoint & jnp.isfinite(req0),
+                              req0 - arr, jnp.inf))
+    worst = jnp.where(jnp.isfinite(worst), worst, 0.0)
 
     # per in-edge slack -> criticality, scattered to (net, sink) slots
     # max_crit clamp (VPR --max_criticality 0.99 default): a criticality of
     # exactly 1 would zero the congestion term and livelock negotiation
     slack = req[:, None] - arr[dev.in_src] - d_in          # [T, D]
-    denom = jnp.maximum(dmax, 1e-30)
     crit = jnp.clip(1.0 - slack / denom, 0.0, max_crit)
     if crit_exp != 1.0:
         crit = crit ** crit_exp
@@ -99,29 +138,48 @@ def sta_sweep(dev: DeviceTimingGraph, route_delay: jnp.ndarray,
     idx = jnp.where(ok, dev.in_ridx, RS)
     crit_flat = jnp.zeros(RS + 1, jnp.float32).at[idx.ravel()].max(
         jnp.where(ok, crit, 0.0).ravel())
-    return crit_flat[:RS], dmax, arr
+    return crit_flat[:RS], dmax, worst, arr
 
 
 class TimingAnalyzer:
-    """Host wrapper: owns the device graph, exposes the router callback."""
+    """Host wrapper: owns the device graph, exposes the router callback.
+
+    ``sdc``: optional timing.sdc.SdcConstraints — switches the analysis
+    to constrained mode (per-clock-domain required times, read_sdc.c
+    application semantics); without it a single ideal clock normalised to
+    the critical path is assumed (stock path_delay.c behavior)."""
 
     def __init__(self, tg: TimingGraph, crit_exp: float = 1.0,
-                 max_crit: float = 0.99):
+                 max_crit: float = 0.99, sdc=None):
         self.tg = tg
         self.dev = to_device(tg)
         self.crit_exp = crit_exp
         self.max_crit = max_crit
         self.crit_path_delay = float("nan")
+        self.worst_slack = float("nan")
+        self.sdc = sdc
+        self._req_seed = None
+        if sdc is not None:
+            req = np.full(tg.num_tnodes, np.inf, dtype=np.float32)
+            default = sdc.default_period or np.inf
+            for t in np.where(tg.is_endpoint)[0]:
+                d = int(tg.endpoint_domain[t])
+                p = (sdc.period_of(tg.domains[d]) if d >= 0 else default)
+                req[t] = p if p is not None else np.inf
+            self._req_seed = jnp.asarray(req)
 
     def analyze(self, sink_delay: np.ndarray) -> np.ndarray:
         """sink_delay [R, Smax] from the router -> criticalities [R, Smax];
-        also records crit_path_delay (seconds)."""
+        also records crit_path_delay and (SDC mode) worst_slack, both in
+        seconds."""
         R, Smax = sink_delay.shape
         flat = np.append(sink_delay.ravel().astype(np.float32), 0.0)
-        crit, dmax, _ = sta_sweep(self.dev, jnp.asarray(flat),
-                                  self.tg.depth, self.crit_exp,
-                                  self.max_crit)
+        crit, dmax, worst, _ = sta_sweep(
+            self.dev, jnp.asarray(flat), self.tg.depth, self.crit_exp,
+            self.max_crit, req_seed=self._req_seed,
+            use_sdc=self._req_seed is not None)
         self.crit_path_delay = float(dmax)
+        self.worst_slack = float(worst)
         return np.asarray(crit).reshape(R, Smax)
 
     def timing_cb(self, result) -> np.ndarray:

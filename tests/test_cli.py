@@ -90,3 +90,38 @@ def test_binary_search_wmin():
                        RouterOpts(batch_size=16, max_router_iterations=25),
                        timing_driven=False, verify=False)
         assert not f2.route.success
+
+
+def test_cli_draw_svg(tmp_path):
+    from parallel_eda_tpu.__main__ import main
+    out = str(tmp_path / "o")
+    draw = str(tmp_path / "d")
+    rc = main(["--luts", "20", "--route_chan_width", "16",
+               "--moves_per_step", "16", "--no_timing",
+               "--out_dir", out, "--draw", draw])
+    assert rc == 0
+    import os
+    for name in ("placement.svg", "routing.svg"):
+        p = os.path.join(draw, name)
+        assert os.path.exists(p)
+        body = open(p).read()
+        assert body.startswith("<svg") and "</svg>" in body
+
+
+def test_cli_settings_file_and_conflicts(tmp_path):
+    import pytest
+    from parallel_eda_tpu.__main__ import main
+    # settings file provides defaults; explicit CLI flags win
+    sf = tmp_path / "settings.txt"
+    sf.write_text("# defaults\nluts 20\nroute_chan_width 16\n"
+                  "moves_per_step 16\nno_timing\n")
+    out = str(tmp_path / "o")
+    rc = main(["--settings_file", str(sf), "--out_dir", out])
+    assert rc == 0
+    # conflicting options are rejected (CheckOptions.c semantics)
+    with pytest.raises(SystemExit):
+        main(["--binary_search", "--route_chan_width", "24"])
+    with pytest.raises(SystemExit):
+        main(["--sdc", "x.sdc", "--no_timing"])
+    with pytest.raises(SystemExit):
+        main(["--mesh", "bogus"])

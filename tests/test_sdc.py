@@ -1,0 +1,107 @@
+"""SDC parsing + multi-clock STA (read_sdc.c subset equivalent)."""
+
+import numpy as np
+
+from parallel_eda_tpu.arch.builtin import minimal_arch
+from parallel_eda_tpu.flow import prepare, run_route
+from parallel_eda_tpu.netlist.netlist import (LogicalNetlist, Primitive,
+                                              PRIM_FF, PRIM_INPAD,
+                                              PRIM_LUT, PRIM_OUTPAD)
+from parallel_eda_tpu.timing.sdc import parse_sdc
+
+
+def test_parse_sdc_subset():
+    sdc = parse_sdc("""
+    # two port clocks + a virtual clock
+    create_clock -period 4.0 clk_a
+    create_clock -period 1.5 [get_ports {clk_b}]
+    create_clock -period 8 -name virt
+    set_clock_groups -exclusive -group {clk_a} -group {clk_b}
+    set_false_path -from foo -to bar
+    """)
+    approx = lambda a, b: abs(a - b) < 1e-15
+    assert approx(sdc.clock_periods["clk_a"], 4.0e-9)
+    assert approx(sdc.clock_periods["clk_b"], 1.5e-9)
+    assert approx(sdc.virtual_clocks["virt"], 8e-9)
+    assert approx(sdc.default_period, 8e-9)
+    assert ["clk_a"] in sdc.exclusive_groups
+    assert ["clk_b"] in sdc.exclusive_groups
+
+
+def _two_clock_netlist(depth_a=3, depth_b=1):
+    """Two registered LUT chains on different clocks: chain A (deep) on
+    clk_a, chain B (shallow) on clk_b."""
+    nl = LogicalNetlist(name="twoclk")
+    for c in ("clk_a", "clk_b"):
+        nl.add(Primitive(name=c, kind=PRIM_INPAD, output=c))
+    for tag, clk, depth in (("a", "clk_a", depth_a), ("b", "clk_b", depth_b)):
+        nl.add(Primitive(name=f"in_{tag}", kind=PRIM_INPAD,
+                         output=f"in_{tag}"))
+        nl.add(Primitive(name=f"r{tag}0", kind=PRIM_FF,
+                         inputs=[f"in_{tag}"], output=f"r{tag}0", clock=clk))
+        prev = f"r{tag}0"
+        for d in range(depth):
+            out = f"l{tag}{d}"
+            nl.add(Primitive(name=out, kind=PRIM_LUT, inputs=[prev],
+                             output=out, truth_table=["1 1"]))
+            prev = out
+        nl.add(Primitive(name=f"r{tag}z", kind=PRIM_FF, inputs=[prev],
+                         output=f"r{tag}z", clock=clk))
+        nl.add(Primitive(name=f"out:{tag}", kind=PRIM_OUTPAD,
+                         inputs=[f"r{tag}z"]))
+    nl.finalize()
+    return nl
+
+
+def _host_sta_oracle(tg, sink_delay, req_of_domain, default_req):
+    """Independent host longest-path oracle over the timing DAG (edge-list
+    relaxation, not the device's ELL sweeps)."""
+    T = tg.num_tnodes
+    arr = tg.arrival0.astype(np.float64).copy()
+    rd = np.append(sink_delay.ravel(), 0.0)
+    for _ in range(tg.depth):
+        for v in range(T):
+            for d in range(tg.in_src.shape[1]):
+                if not tg.in_valid[v, d]:
+                    continue
+                w = arr[tg.in_src[v, d]] + tg.in_const[v, d] \
+                    + rd[tg.in_ridx[v, d]]
+                arr[v] = max(arr[v], w)
+    worst = np.inf
+    for v in np.where(tg.is_endpoint)[0]:
+        dom = int(tg.endpoint_domain[v])
+        req = req_of_domain.get(tg.domains[dom], default_req) if dom >= 0 \
+            else default_req
+        worst = min(worst, req - arr[v])
+    return float(np.max(arr[tg.is_endpoint])), float(worst)
+
+
+def test_multi_clock_slack_matches_oracle():
+    nl = _two_clock_netlist()
+    flow = prepare(nl, minimal_arch(), chan_width=10)
+    flow.sdc = parse_sdc(
+        "create_clock -period 100.0 clk_a\n"
+        "create_clock -period 2.0 clk_b\n")
+    flow = run_route(flow)
+    assert flow.route.success
+    a = flow.analyzer
+    assert np.isfinite(a.worst_slack)
+    dmax, worst = _host_sta_oracle(
+        flow.tg, flow.route.sink_delay,
+        {"clk_a": 100e-9, "clk_b": 2e-9}, 100e-9)
+    assert abs(a.crit_path_delay - dmax) < 1e-12 + 1e-4 * abs(dmax)
+    assert abs(a.worst_slack - worst) < 1e-12 + 1e-4 * abs(worst)
+    # the tight clk_b domain must dominate criticality even though the
+    # clk_a chain is deeper
+    assert worst == min(worst, 100e-9 - dmax)
+
+
+def test_sdc_violated_slack_reported():
+    nl = _two_clock_netlist()
+    flow = prepare(nl, minimal_arch(), chan_width=10)
+    # absurdly tight clock: slack must go negative, route still succeeds
+    flow.sdc = parse_sdc("create_clock -period 0.001 clk_a\n"
+                         "create_clock -period 0.001 clk_b\n")
+    flow = run_route(flow)
+    assert flow.route.success
+    assert flow.analyzer.worst_slack < 0

@@ -1,0 +1,39 @@
+"""Native serial SA baseline: builds, anneals, agrees with the JAX cost
+oracle (place.c try_place semantics; BASELINE.md SA moves/sec baseline)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from parallel_eda_tpu.flow import synth_flow
+from parallel_eda_tpu.place.sa import build_place_problem, net_bb_cost
+from parallel_eda_tpu.place.serial_sa import serial_sa_place
+
+
+def test_serial_sa_improves_and_matches_oracle():
+    flow = synth_flow(num_luts=60, num_inputs=8, num_outputs=8,
+                      chan_width=12, seed=5)
+    pp = build_place_problem(flow.pnl, flow.grid)
+    c0 = float(net_bb_cost(pp, jnp.asarray(flow.pos))[0])
+    res = serial_sa_place(flow.pnl, flow.grid, flow.pos, seed=7)
+    assert res.proposed > 0 and res.accepted > 0
+    # internal incremental cost must equal the independent JAX oracle
+    c1 = float(net_bb_cost(pp, jnp.asarray(res.pos))[0])
+    assert abs(res.final_cost - c1) < 1e-3 * max(1.0, c1)
+    # annealing must actually improve the placement
+    assert c1 < 0.8 * c0
+    # every block still on a legal site of its own type
+    for bi in range(flow.pnl.num_blocks):
+        x, y = int(res.pos[bi, 0]), int(res.pos[bi, 1])
+        if flow.pnl.block_type(bi).is_io:
+            assert flow.grid.is_io(x, y)
+        else:
+            assert flow.grid.is_clb(x, y)
+
+
+def test_serial_sa_deterministic():
+    flow = synth_flow(num_luts=40, num_inputs=6, num_outputs=6,
+                      chan_width=12, seed=9)
+    a = serial_sa_place(flow.pnl, flow.grid, flow.pos, seed=42)
+    b = serial_sa_place(flow.pnl, flow.grid, flow.pos, seed=42)
+    assert np.array_equal(a.pos, b.pos)
+    assert a.proposed == b.proposed and a.accepted == b.accepted
