@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pres_fac_mult", type=float, default=1.3)
     p.add_argument("--acc_fac", type=float, default=1.0)
     p.add_argument("--bb_factor", type=int, default=3)
+    p.add_argument("--astar_fac", type=float, default=1.0,
+                   help="A* pruning aggressiveness in the bb-windowed "
+                   "search (VPR --astar_fac; 1.0 admissible, >1 faster/"
+                   "riskier; no effect on full-device searches)")
     p.add_argument("--batch_size", type=int, default=64,
                    help="nets routed concurrently (replaces --num_threads)")
     p.add_argument("--sink_group", type=int, default=1)
@@ -66,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stats_dir", default="",
                    help="write per-run iter_stats.txt / final_stats.txt "
                    "here (the reference's <circuit>_stats_N/ files)")
+    p.add_argument("--profile", default="",
+                   help="capture a device profiler trace of routing into "
+                   "this dir (xprof/XPlane; view with TensorBoard — the "
+                   "reference's VTune/LTTng tracing analogue)")
     p.add_argument("--no_timing", action="store_true",
                    help="congestion-driven only (NO_TIMING algorithm)")
     p.add_argument("--sdc", default="",
@@ -130,6 +138,8 @@ def check_options(args) -> None:
         errs.append("--timing_tradeoff must be in [0, 1]")
     if args.sdc and args.no_timing:
         errs.append("--sdc needs timing analysis; drop --no_timing")
+    if args.profile and not args.route:
+        errs.append("--profile traces routing; drop --no_route")
     if errs:
         raise SystemExit("option errors:\n  " + "\n  ".join(errs))
 
@@ -230,16 +240,25 @@ def main(argv=None) -> int:
             initial_pres_fac=args.initial_pres_fac,
             pres_fac_mult=args.pres_fac_mult,
             acc_fac=args.acc_fac, bb_factor=args.bb_factor,
+            astar_fac=args.astar_fac,
             batch_size=args.batch_size, sink_group=args.sink_group,
             stats_dir=args.stats_dir or None)
-        if args.binary_search:
-            wmin = binary_search_route(flow, ropts,
-                                       timing_driven=not args.no_timing,
-                                       mesh=mesh)
-            print(f"binary search: W_min = {wmin}")
-        else:
-            run_route(flow, ropts, timing_driven=not args.no_timing,
-                      mesh=mesh)
+        import contextlib
+        prof = contextlib.nullcontext()
+        if args.profile:
+            import jax
+            prof = jax.profiler.trace(args.profile)
+        with prof:
+            if args.binary_search:
+                wmin = binary_search_route(
+                    flow, ropts, timing_driven=not args.no_timing,
+                    mesh=mesh)
+                print(f"binary search: W_min = {wmin}")
+            else:
+                run_route(flow, ropts, timing_driven=not args.no_timing,
+                          mesh=mesh)
+        if args.profile:
+            print(f"profiler trace in {args.profile}")
         r = flow.route
         if not r.success:
             print(f"ROUTING FAILED after {r.iterations} iterations "

@@ -67,6 +67,17 @@ class RouterOpts:
     window_max_frac: float = 0.7
     # or when the localized tables would exceed this many bytes
     window_max_bytes: int = 4 << 30
+    # A* aggressiveness: scales the admissible lower bound (VPR
+    # --astar_fac, SetupVPR.c:332 default 1.2; 1.0 = provably optimal
+    # per-sink paths, >1 prunes harder for speed at a QoR risk).  Only
+    # the windowed search has the A* gate — this knob is inert for
+    # full-device (global-program) routing
+    astar_fac: float = 1.0
+    # phase-two safety valve (…cxx:6238-6267 two-phase mode switch +
+    # mpi plateau shrink): when the overused-node count improves < 5%
+    # for this many consecutive iterations, the still-congested nets
+    # get full-device bounding boxes so negotiation can detour globally
+    plateau_iters: int = 8
     # per-run stats directory: writes iter_stats.txt / final_stats.txt in
     # the reference's schema (…cxx:5925-5935, 6344-6360); None = off
     stats_dir: Optional[str] = None
@@ -293,13 +304,16 @@ class Router:
                          for lo in range(0, R, chunk)]
                 win = (parts[0] if len(parts) == 1 else jax.tree.map(
                     lambda *xs: jnp.concatenate(xs, axis=0), *parts))
-                lb_scale = jnp.asarray(self._lb_scale(), dtype=jnp.float32)
+                lb_scale = jnp.asarray(
+                    self._lb_scale(), dtype=jnp.float32) * opts.astar_fac
         wide = np.zeros(R, dtype=bool)   # nets whose bb covers the device
 
         pres_fac = opts.initial_pres_fac
         result = RouteResult(False, 0, None, None, None, 0)
         n_over = -1                      # previous iteration's overuse
         crit_d = None                    # uploaded once; refreshed on cb
+        stall = 0                        # phase-two plateau counter
+        best_over = 1 << 30              # best overuse seen so far
 
         for it in range(1, opts.max_router_iterations + 1):
             t0 = time.time()
@@ -381,6 +395,25 @@ class Router:
                                full_bb[None, :], bb)
 
             n_over, over_total = (int(v) for v in overuse_summary(dev, occ))
+            # phase-two safety valve (…cxx:6238-6267): only a genuine
+            # stagnation trips it — the counter resets whenever the BEST
+            # overuse seen improves, so steady-but-slow convergence
+            # (e.g. 4%/iter) never triggers the widening cliff
+            if 0 < best_over * 0.95 < n_over:
+                stall += 1
+            else:
+                stall = 0
+            if 0 <= n_over < best_over:
+                best_over = n_over
+            if stall >= opts.plateau_iters and n_over > 0:
+                stuck = np.asarray(reroute_mask(dev, occ, paths,
+                                                all_reached)) & ~wide
+                if stuck.any():
+                    wide |= stuck
+                    result.widened_nets += int(stuck.sum())
+                    bb = jnp.where(jnp.asarray(stuck)[:, None],
+                                   full_bb[None, :], bb)
+                stall = 0
             result.total_relax_steps += it_steps
             result.stats.append(RouteStats(
                 it, n_over, over_total, len(idx), time.time() - t0,
