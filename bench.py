@@ -79,7 +79,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--luts", type=int, default=60)
     ap.add_argument("--chan_width", type=int, default=12)
-    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--skip_serial", action="store_true",
                     help="report device throughput only (vs_baseline 0)")
     args = ap.parse_args()

@@ -267,6 +267,8 @@ def main(argv=None) -> int:
         print(f"routed: {r.iterations} iterations, "
               f"wirelength {r.wirelength}, "
               f"{flow.times['route']:.2f}s")
+        from .route.report import route_report
+        print(route_report(flow.rr, r.occ, len(flow.term.net_ids)))
         if not args.no_timing:
             print(f"critical path: {flow.crit_path_delay * 1e9:.3f} ns")
             if flow.sdc is not None:

@@ -621,4 +621,10 @@ class Placer:
         stats.final_td_cost = float(tdc)
         if self.timing is not None:
             _, stats.est_crit_path = self._crit(np.asarray(pos))
-        return np.asarray(pos), stats
+        # final legality audit (check_place, place.c:253): an annealer
+        # bug must never hand the router an illegal placement silently
+        from .check import check_place
+
+        pos_np = np.asarray(pos)
+        check_place(self.pnl, self.grid, pos_np)
+        return pos_np, stats

@@ -396,15 +396,15 @@ class Router:
 
             n_over, over_total = (int(v) for v in overuse_summary(dev, occ))
             # phase-two safety valve (…cxx:6238-6267): only a genuine
-            # stagnation trips it — the counter resets whenever the BEST
-            # overuse seen improves, so steady-but-slow convergence
-            # (e.g. 4%/iter) never triggers the widening cliff
-            if 0 < best_over * 0.95 < n_over:
-                stall += 1
-            else:
+            # stagnation trips it — ANY new best overuse resets the
+            # counter, so steadily converging runs never see the
+            # widening cliff; plateau_iters iterations without a new
+            # best is stagnation
+            if n_over < best_over:
                 stall = 0
-            if 0 <= n_over < best_over:
                 best_over = n_over
+            elif n_over > 0:
+                stall += 1
             if stall >= opts.plateau_iters and n_over > 0:
                 stuck = np.asarray(reroute_mask(dev, occ, paths,
                                                 all_reached)) & ~wide
@@ -445,4 +445,9 @@ class Router:
         result.occ = np.asarray(occ)
         if opts.stats_dir:
             write_stats_files(opts.stats_dir, result)
+            from .report import write_route_report
+            import os
+            write_route_report(
+                os.path.join(opts.stats_dir, "route_report.txt"),
+                rr, result.occ, R)
         return result

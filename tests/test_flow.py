@@ -30,3 +30,29 @@ def test_flow_placement_improves_routing():
     f1 = run_route(f1, RouterOpts(batch_size=16), timing_driven=False)
     assert f1.route.success
     assert f1.route.wirelength < wl_initial * 1.05
+
+
+def test_route_report_and_check_place():
+    # stats.c routing_stats + check_place final audit equivalents
+    import numpy as np
+    import pytest
+    from parallel_eda_tpu.flow import synth_flow, run_place, run_route
+    from parallel_eda_tpu.place.check import check_place
+    from parallel_eda_tpu.place.sa import PlacerOpts
+    from parallel_eda_tpu.route.report import route_report
+
+    flow = synth_flow(num_luts=25, num_inputs=4, num_outputs=4,
+                      chan_width=12, seed=3)
+    flow = run_place(flow, PlacerOpts(moves_per_step=16, max_temps=20,
+                                      timing_tradeoff=0.0),
+                     timing_driven=False)
+    check_place(flow.pnl, flow.grid, flow.pos)   # must pass
+    flow = run_route(flow, timing_driven=False)
+    rep = route_report(flow.rr, flow.route.occ, len(flow.term.net_ids))
+    assert "total wirelength" in rep and "CHANX utilization" in rep
+    assert "overused nodes: 0" in rep
+    # a corrupted placement must be rejected
+    bad = flow.pos.copy()
+    bad[0] = bad[1]
+    with pytest.raises(ValueError):
+        check_place(flow.pnl, flow.grid, bad)
