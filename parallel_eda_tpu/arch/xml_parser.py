@@ -18,7 +18,8 @@ import warnings
 import xml.etree.ElementTree as ET
 from typing import Optional
 
-from .model import Arch, SegmentInf, SwitchInf, make_clb_type, make_io_type
+from .model import (Arch, ColumnSpec, SegmentInf, SwitchInf, make_clb_type,
+                    make_hard_type, make_io_type)
 
 
 def _f(attrib: dict, key: str, default: float) -> float:
@@ -112,10 +113,14 @@ def read_arch_xml(path: str) -> Arch:
             return True
         return False
 
-    # --- complex blocks: extract io capacity + cluster K/N/I summary ---
+    # --- complex blocks: extract io capacity + cluster K/N/I summary;
+    # later top-level pb_types (memory, mult, ...) become heterogeneous
+    # hard block types with column assignments (t_type_descriptor +
+    # SetupGrid.c col fill) ---
     io_capacity = 8
     K, N, I = 6, 10, 33
     cluster_pb = None
+    hard_pbs = []
     cbl = root.find("complexblocklist")
     if cbl is not None:
         for pb in cbl.findall("pb_type"):
@@ -127,6 +132,8 @@ def read_arch_xml(path: str) -> Arch:
             # ones (memory, mult, ...) don't override its geometry
             if cluster_pb is None:
                 cluster_pb = pb
+            else:
+                hard_pbs.append(pb)
         if cluster_pb is not None:
             num_in = sum(int(float(e.attrib.get("num_pins", 0)))
                          for e in cluster_pb.findall("input"))
@@ -155,9 +162,61 @@ def read_arch_xml(path: str) -> Arch:
         if dev is not None:
             _read_fc(dev)
 
+    # --- cluster timing (delay_constant / T_setup / T_clk_to_Q under the
+    # cluster pb tree, ProcessPb_Type timing annotations) ---
+    def _pb_timing(pb, defaults=(400e-12, 60e-12, 80e-12)):
+        t_comb, t_setup, t_cq = defaults
+        if pb is None:
+            return t_comb, t_setup, t_cq
+        dels = [_f(e.attrib, "max", 0.0) for e in pb.iter("delay_constant")]
+        if dels and max(dels) > 0:
+            t_comb = max(dels)
+        for e in pb.iter("T_setup"):
+            t_setup = _f(e.attrib, "value", t_setup)
+        for e in pb.iter("T_clk_to_Q"):
+            t_cq = _f(e.attrib, "max", _f(e.attrib, "value", t_cq))
+        return t_comb, t_setup, t_cq
+
     arch.K, arch.N, arch.I, arch.io_capacity = K, N, I, io_capacity
+    t_comb, t_setup, t_cq = _pb_timing(cluster_pb)
     arch.block_types = [
         make_io_type(index=0, capacity=io_capacity),
-        make_clb_type(index=1, K=K, N=N, I=I),
+        make_clb_type(index=1, K=K, N=N, I=I, T_comb=t_comb,
+                      T_setup=t_setup, T_clk_to_q=t_cq),
     ]
+
+    # --- heterogeneous hard blocks: pin counts + .subckt model mapping +
+    # VPR7 <gridlocations><loc type="col" start= repeat=> columns ---
+    for pb in hard_pbs:
+        name = pb.attrib.get("name", f"hard{len(arch.block_types)}")
+        num_in = sum(int(float(e.attrib.get("num_pins", 0)))
+                     for e in pb.findall("input"))
+        num_out = sum(int(float(e.attrib.get("num_pins", 0)))
+                      for e in pb.findall("output"))
+        if not num_in or not num_out:
+            warnings.warn(f"{path}: pb_type {name} has no pins; skipped")
+            continue
+        ht_comb, ht_setup, ht_cq = _pb_timing(
+            pb, (1.5e-9, 100e-12, 400e-12))
+        arch.block_types.append(make_hard_type(
+            name, index=len(arch.block_types), num_in=num_in,
+            num_out=num_out, T_comb=ht_comb, T_setup=ht_setup,
+            T_clk_to_q=ht_cq))
+        for inner in pb.iter("pb_type"):
+            model = inner.attrib.get("blif_model", "")
+            toks = model.split(None, 1)
+            if toks and toks[0] == ".subckt" and len(toks) > 1:
+                arch.hard_models[toks[1].strip()] = name
+        # one ColumnSpec per <loc type="col"> (VPR7 archs legally list
+        # several column sets for one type)
+        specs = []
+        gl = pb.find("gridlocations")
+        if gl is not None:
+            for loc in gl.findall("loc"):
+                if loc.attrib.get("type") == "col":
+                    specs.append(ColumnSpec(
+                        name,
+                        start=int(float(loc.attrib.get("start", 4))),
+                        repeat=int(float(loc.attrib.get("repeat", 8)))))
+        arch.column_types.extend(specs or [ColumnSpec(name)])
     return arch

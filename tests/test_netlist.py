@@ -242,3 +242,47 @@ def test_timing_driven_packer_packs_critical_chains_together():
     # packer must achieve that bound and never lose to greedy
     assert cuts_td <= cuts_greedy
     assert cuts_td <= 3
+
+
+def test_arch_xml_hard_blocks_and_columns(tmp_path):
+    """Later pb_types become heterogeneous hard block types: pin counts,
+    .subckt model mapping, VPR7 gridlocations column assignment, and
+    timing annotations (ProcessPb_Type + SetupGrid.c col semantics)."""
+    xml = """<architecture>
+  <complexblocklist>
+    <pb_type name="io" capacity="4"/>
+    <pb_type name="clb">
+      <input name="I" num_pins="20"/>
+      <output name="O" num_pins="8"/>
+      <delay_constant max="300e-12"/>
+      <T_setup value="50e-12"/>
+      <T_clk_to_Q max="100e-12"/>
+    </pb_type>
+    <pb_type name="memory">
+      <input name="addr" num_pins="9"/>
+      <input name="data" num_pins="8"/>
+      <output name="out" num_pins="8"/>
+      <clock name="clk" num_pins="1"/>
+      <delay_constant max="2.0e-9"/>
+      <pb_type name="mem_512x8" blif_model=".subckt sp_mem">
+        <input name="addr" num_pins="9"/>
+        <output name="out" num_pins="8"/>
+      </pb_type>
+      <gridlocations><loc type="col" start="3" repeat="5" priority="2"/></gridlocations>
+    </pb_type>
+  </complexblocklist>
+</architecture>"""
+    p = tmp_path / "arch.xml"
+    p.write_text(xml)
+    arch = read_arch_xml(str(p))
+    mem = arch.block_type("memory")
+    assert mem.num_input_pins == 17 and mem.num_output_pins == 8
+    assert abs(mem.T_comb - 2.0e-9) < 1e-15
+    assert arch.hard_models == {"sp_mem": "memory"}
+    assert len(arch.column_types) == 1
+    spec = arch.column_types[0]
+    assert (spec.type_name, spec.start, spec.repeat) == ("memory", 3, 5)
+    clb = arch.block_type("clb")
+    assert abs(clb.T_comb - 300e-12) < 1e-15
+    assert abs(clb.T_setup - 50e-12) < 1e-15
+    assert abs(clb.T_clk_to_q - 100e-12) < 1e-15

@@ -94,3 +94,35 @@ def test_timing_driven_route_loop():
     ta.analyze(res1.sink_delay)
     assert np.isfinite(ta.crit_path_delay)
     assert ta.crit_path_delay <= base * 1.05
+
+
+def test_elmore_oracle_vs_router_delays():
+    # net_delay.c equivalent: independent Elmore delays over the routed
+    # trees.  With buffered switches (this arch) the Elmore sum along any
+    # path must equal the router's accumulated per-edge delays exactly;
+    # the pass-transistor variant adds sibling/downstream loading and can
+    # only be larger.
+    import numpy as np
+    from parallel_eda_tpu.flow import routes_from_result, synth_flow, run_route
+    from parallel_eda_tpu.timing.elmore import elmore_tree_delays
+
+    flow = synth_flow(num_luts=30, num_inputs=5, num_outputs=5,
+                      chan_width=12, seed=4)
+    flow = run_route(flow, timing_driven=False)
+    assert flow.route.success
+    trees = routes_from_result(flow.term, flow.route, flow.rr.num_nodes)
+    term = flow.term
+    checked = 0
+    for r, ni in enumerate(term.net_ids):
+        tree = trees[int(ni)]
+        d = elmore_tree_delays(flow.rr, tree, buffered=True)
+        d_pass = elmore_tree_delays(flow.rr, tree, buffered=False)
+        for s in range(int(term.num_sinks[r])):
+            sink = int(term.sinks[r, s])
+            rd = float(flow.route.sink_delay[r, s])
+            assert sink in d
+            assert abs(d[sink] - rd) < 1e-12 + 1e-5 * abs(rd), \
+                f"net {ni} sink {sink}: elmore {d[sink]} vs {rd}"
+            assert d_pass[sink] >= d[sink] - 1e-15
+            checked += 1
+    assert checked > 20
