@@ -81,6 +81,10 @@ class RouterOpts:
     # per-run stats directory: writes iter_stats.txt / final_stats.txt in
     # the reference's schema (…cxx:5925-5935, 6344-6360); None = off
     stats_dir: Optional[str] = None
+    # also dump every iteration's routes to routes_iter_N.txt in
+    # stats_dir (…cxx:6167 diagnostics; pulls paths off-device each
+    # iteration, debug only)
+    dump_routes: bool = False
 
 
 @dataclass
@@ -172,6 +176,33 @@ def write_stats_files(stats_dir: str, result: "RouteResult") -> None:
         f.write(f"final_crit_path_delay {cpd:.6e}\n")
 
 
+def _spatial_order(idx: np.ndarray, cx: np.ndarray, cy: np.ndarray,
+                   nx: int, ny: int, grid_bins: int = 4) -> np.ndarray:
+    """Order nets so consecutive ones come from DIFFERENT regions of the
+    device: bin net centers into a grid_bins x grid_bins map and deal
+    round-robin across bins.  Consecutive nets become one batch, so batch
+    peers are spatially spread — less overlap, fewer congestion conflicts
+    per commit (the net-axis load-balancing role of the reference's
+    spatial net partitioning, split_nets_recursive
+    partitioning_multi_sink_delta_stepping_route.cxx:2648 +
+    new_partitioner.cxx median cuts, re-aimed at batches instead of
+    threads)."""
+    if len(idx) <= 1:
+        return idx
+    bx = np.clip((cx[idx] * grid_bins) // max(1, nx + 2), 0, grid_bins - 1)
+    by = np.clip((cy[idx] * grid_bins) // max(1, ny + 2), 0, grid_bins - 1)
+    bins = (bx * grid_bins + by).astype(np.int64)
+    # stable sort by bin, then deal one net per bin per round
+    order = np.argsort(bins, kind="stable")
+    sorted_bins = bins[order]
+    # position of each net within its bin
+    _, starts = np.unique(sorted_bins, return_index=True)
+    within = np.arange(len(order)) - starts[
+        np.searchsorted(sorted_bins[starts], sorted_bins)]
+    deal = np.lexsort((sorted_bins, within))
+    return idx[order[deal]]
+
+
 def _pad_to(a: np.ndarray, B: int, fill) -> np.ndarray:
     n = a.shape[0]
     if n == B:
@@ -216,6 +247,25 @@ class Router:
             self._s_batch = NamedSharding(mesh, P(NET))
             self._s_node = NamedSharding(mesh, P(NODE))
             self._net_axis = mesh.shape[NET]
+
+    @staticmethod
+    def _dump_routes(stats_dir: str, it: int, paths: np.ndarray,
+                     N: int) -> None:
+        """routes_iter_N.txt per-iteration dump (…cxx:6167 diagnostics):
+        one line per (net, sink) with the node path sink->tree."""
+        import os
+
+        os.makedirs(stats_dir, exist_ok=True)
+        with open(os.path.join(stats_dir, f"routes_iter_{it}.txt"),
+                  "w") as f:
+            R, S, _ = paths.shape
+            for r in range(R):
+                for s in range(S):
+                    seg = paths[r, s]
+                    seg = seg[seg < N]
+                    if seg.size:
+                        f.write(f"{r} {s}: " +
+                                " ".join(str(v) for v in seg) + "\n")
 
     def _lb_scale(self):
         """Admissible (congestion, delay) cost floors per manhattan tile
@@ -280,6 +330,8 @@ class Router:
         source_d = jnp.asarray(term.source.astype(np.int32))
         sinks_d = jnp.asarray(term.sinks.astype(np.int32))
         nsinks_np = term.num_sinks.astype(np.int64)
+        cx_np = ((term.bb_xmin + term.bb_xmax) // 2).astype(np.int64)
+        cy_np = ((term.bb_ymin + term.bb_ymax) // 2).astype(np.int64)
 
         # --- bb-windowed search setup (VPR's per-net boxes as gathered
         # fixed-size windows; search.py "Bounding-box-windowed search") ---
@@ -333,17 +385,29 @@ class Router:
                 groups = _color_schedule(idx, conflict[:len(idx), :len(idx)])
             else:
                 groups = [idx]
-            # fanout-homogeneous batches: fewer wasted waves; nets whose
-            # bb was widened to the full device can't use the windows and
-            # go through the global-space program in separate batches
+            # batch formation: fanout classes keep the wave loop tight
+            # (peers finish their sinks together), spatial round-robin
+            # inside a class spreads each batch's nets across the device
+            # so concurrent commits rarely contend; the class streams are
+            # concatenated descending-fanout and chunked ONCE, so class
+            # boundaries never multiply dispatches.  Nets whose bb was
+            # widened to the full device can't use the windows and go
+            # through the global-space program in separate batches.
             batches = []
             for g in groups:
                 parts = ((g[~wide[g]], g[wide[g]]) if win is not None
                          else (g,))
                 for gp in parts:
-                    gp = gp[np.argsort(-nsinks_np[gp], kind="stable")]
-                    batches.extend(gp[lo:lo + B]
-                                   for lo in range(0, len(gp), B))
+                    if len(gp) == 0:
+                        continue
+                    cls = np.ceil(np.log2(np.maximum(
+                        1, nsinks_np[gp]).astype(float))).astype(np.int64)
+                    ordered = np.concatenate([
+                        _spatial_order(gp[cls == c], cx_np, cy_np,
+                                       rr.grid.nx, rr.grid.ny)
+                        for c in sorted(set(cls.tolist()), reverse=True)])
+                    batches.extend(ordered[lo:lo + B]
+                                   for lo in range(0, len(ordered), B))
 
             # one static wave cap for every batch: the wave loop is a
             # device while_loop that exits early once all sinks are done,
@@ -419,6 +483,9 @@ class Router:
                 it, n_over, over_total, len(idx), time.time() - t0,
                 relax_steps=it_steps, batches=len(batches),
                 overuse_pct=100.0 * n_over / max(1, N)))
+
+            if opts.stats_dir and opts.dump_routes:
+                self._dump_routes(opts.stats_dir, it, np.asarray(paths), N)
 
             if n_over == 0 and bool(jnp.all(all_reached)):
                 result.success = True

@@ -121,3 +121,30 @@ def test_route_windowed_deterministic():
     b = Router(rr, RouterOpts(batch_size=16)).route(term)
     assert a.success and b.success
     assert np.array_equal(a.paths, b.paths)
+
+
+def test_spatial_order_round_robins_bins():
+    from parallel_eda_tpu.route.router import _spatial_order
+    # 8 nets: 4 in the left half, 4 in the right; round-robin must
+    # alternate regions rather than keep halves contiguous
+    idx = np.arange(8)
+    cx = np.array([1, 1, 1, 1, 9, 9, 9, 9])
+    cy = np.array([1, 1, 1, 1, 9, 9, 9, 9])
+    out = _spatial_order(idx, cx, cy, nx=8, ny=8, grid_bins=2)
+    halves = (cx[out] > 4).astype(int)
+    # dealing one net per bin per round alternates the two regions
+    assert np.abs(np.diff(halves)).sum() == 7, halves.tolist()
+    assert sorted(out.tolist()) == idx.tolist()
+
+
+def test_route_dump_routes(tmp_path):
+    _, _, _, _, rr, term = _flow(num_luts=20, chan_width=12, seed=2)
+    sd = str(tmp_path / "stats")
+    res = Router(rr, RouterOpts(batch_size=16, stats_dir=sd,
+                                dump_routes=True)).route(term)
+    assert res.success
+    import os
+    dumps = [f for f in os.listdir(sd) if f.startswith("routes_iter_")]
+    assert len(dumps) == res.iterations
+    body = open(os.path.join(sd, "routes_iter_1.txt")).read()
+    assert ":" in body
