@@ -83,6 +83,7 @@ def main():
     ap.add_argument("--skip_serial", action="store_true",
                     help="report device throughput only (vs_baseline 0)")
     args = ap.parse_args()
+    serial_error = None
 
     _enable_compile_cache()
     platform = init_backend()
@@ -118,14 +119,23 @@ def main():
         from parallel_eda_tpu.route.serial_ref import SerialRouter
 
         t0 = time.time()
-        sres = SerialRouter(rr).route(term)
+        try:
+            sres = SerialRouter(rr).route(term)
+        except Exception as e:   # baseline failure must not kill the line
+            log(f"serial baseline failed: {e}")
+            serial_error = f"{type(e).__name__}: {e}"
+            sres = None
         sdt = time.time() - t0
-        s_routes = sum(s["rerouted"] for s in sres.stats)
-        serial_nets_per_sec = s_routes / sdt
-        log(f"serial route: {sdt:.1f}s, success={sres.success}, "
-            f"{serial_nets_per_sec:.1f} nets/s, "
-            f"wirelength {sres.wirelength}")
-        speedup = nets_per_sec / max(serial_nets_per_sec, 1e-9)
+        if sres is not None:
+            s_routes = sum(s["rerouted"] for s in sres.stats)
+            serial_nets_per_sec = s_routes / max(sdt, 1e-9)
+            log(f"serial route: {sdt:.1f}s, success={sres.success}, "
+                f"{serial_nets_per_sec:.1f} nets/s, "
+                f"wirelength {sres.wirelength}")
+            speedup = nets_per_sec / max(serial_nets_per_sec, 1e-9)
+        else:
+            serial_nets_per_sec = 0.0
+            speedup = 0.0
 
     print(json.dumps({
         "metric": "nets_routed_per_sec",
@@ -143,6 +153,7 @@ def main():
             "serial_nets_per_sec": round(float(serial_nets_per_sec), 2),
             "serial_success": bool(sres.success) if sres else None,
             "serial_wirelength": int(sres.wirelength) if sres else None,
+            "serial_error": serial_error,
             "baseline": "serial_ref heap PathFinder (serial-VPR stand-in)",
         },
     }))

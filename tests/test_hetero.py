@@ -74,3 +74,49 @@ def test_subckt_blif_roundtrip(tmp_path):
     assert all(h.clock == "clk" for h in hard)
     # connectivity identical: same driver map
     assert set(nl2.net_driver) == set(nl.net_driver)
+
+
+def test_xml_arch_drives_hetero_flow(tmp_path):
+    """An arch defined purely in VPR7-style XML (hard pb_type + .subckt
+    model + gridlocations columns) must carry a .subckt netlist through
+    pack -> place -> route end to end."""
+    from parallel_eda_tpu.arch.xml_parser import read_arch_xml
+
+    xml = """<architecture>
+  <switchlist>
+    <switch type="mux" name="0" R="551" Cin="7.7e-15" Cout="12.9e-15" Tdel="58e-12"/>
+  </switchlist>
+  <segmentlist>
+    <segment freq="1" length="1" Rmetal="101" Cmetal="22.5e-15"><mux name="0"/></segment>
+  </segmentlist>
+  <complexblocklist>
+    <pb_type name="io" capacity="8"/>
+    <pb_type name="clb">
+      <input name="I" num_pins="33"/>
+      <output name="O" num_pins="10"/>
+      <fc default_in_type="frac" default_in_val="0.15"
+          default_out_type="frac" default_out_val="0.1"/>
+      <pb_type name="ble"><pb_type name="lut" blif_model=".names">
+        <input name="in" num_pins="6"/><output name="out" num_pins="1"/>
+      </pb_type></pb_type>
+    </pb_type>
+    <pb_type name="bram" blif_model=".subckt spram">
+      <input name="in" num_pins="9"/>
+      <output name="out" num_pins="4"/>
+      <clock name="clk" num_pins="1"/>
+      <gridlocations><loc type="col" start="3" repeat="4"/></gridlocations>
+    </pb_type>
+  </complexblocklist>
+</architecture>"""
+    p = tmp_path / "arch.xml"
+    p.write_text(xml)
+    arch = read_arch_xml(str(p))
+    assert arch.hard_models == {"spram": "bram"}
+    nl = ram_pipeline(n_mems=2, addr_bits=4, data_bits=4)
+    flow = prepare(nl, arch, chan_width=16)
+    flow = run_route(flow, timing_driven=False)
+    assert flow.route.success
+    by_type = {}
+    for b in flow.pnl.blocks:
+        by_type[b.type_name] = by_type.get(b.type_name, 0) + 1
+    assert by_type.get("bram") == 2
