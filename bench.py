@@ -79,14 +79,24 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--luts", type=int, default=60)
     ap.add_argument("--chan_width", type=int, default=12)
-    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--skip_serial", action="store_true",
                     help="report device throughput only (vs_baseline 0)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (smoke tests; the "
+                         "sitecustomize would otherwise dial the tunneled "
+                         "TPU, which can hang when the tunnel is wedged)")
     args = ap.parse_args()
     serial_error = None
 
-    _enable_compile_cache()
-    platform = init_backend()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    else:
+        _enable_compile_cache()
+        platform = init_backend()
     log(f"platform {platform}")
     flow = build(num_luts=args.luts, chan_width=args.chan_width)
     rr, term = flow.rr, flow.term
@@ -97,14 +107,16 @@ def main():
     from parallel_eda_tpu.route import Router, RouterOpts
 
     # warmup: one full route populates the compile cache for every
-    # program variant the negotiation loop can hit
+    # program variant the negotiation loop can hit; the SAME Router is
+    # reused so the device-resident terminal tables are uploaded once
+    router = Router(rr, RouterOpts(batch_size=args.batch))
     t0 = time.time()
-    res = Router(rr, RouterOpts(batch_size=args.batch)).route(term)
+    res = router.route(term)
     log(f"device warmup route: {time.time() - t0:.1f}s "
         f"(success={res.success}, iters={res.iterations})")
 
     t0 = time.time()
-    res = Router(rr, RouterOpts(batch_size=args.batch)).route(term)
+    res = router.route(term)
     dt = time.time() - t0
     nets_per_sec = res.total_net_routes / dt
     log(f"device route: {dt:.1f}s, {res.total_net_routes} net routes, "

@@ -99,6 +99,14 @@ def read_net_file(path: str, arch: Arch) -> PackedNetlist:
         pin_nets = [-1] * bt.num_pins
         ports = {p.get("name"): (p.text or "") for sec in eb
                  for p in sec.findall("port")}
+        known = {f"c{k}" for k in range(len(bt.pin_classes))}
+        unknown = set(ports) - known
+        if unknown:
+            # the reference's read_netlist.c errors on unknown ports;
+            # dropping them silently would lose net connections
+            raise ValueError(
+                f"block '{eb.get('name')}' ({tname}): unknown port(s) "
+                f"{sorted(unknown)}; expected {sorted(known)}")
         for k, cls in enumerate(bt.pin_classes):
             toks = ports.get(f"c{k}", "").split()
             for j, p in enumerate(cls.pins):

@@ -452,6 +452,74 @@ def sa_temperature(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb,
     return pos, ring_idx, occ, na.sum(), nv.sum(), bb_cost, td_cost
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("M", "steps", "n_temps", "timing"))
+def sa_segment(pp: PlaceProblem, pos, ring_idx, occ, crit, tradeoff,
+               key, t, rlim, exit_t, M: int, steps: int, n_temps: int,
+               timing: bool = False):
+    """A SEGMENT of n_temps whole temperatures as ONE device program:
+    per temperature, all moves (inner scan), then the adaptive
+    temperature/rlim update (update_t place.c:265) computed ON DEVICE
+    from the segment's own success rate.  The host syncs once per
+    segment instead of once per temperature — a device<->host round trip
+    costs ~65 ms through this chip's tunnel, which dominated the placer's
+    wall clock (BENCHMARKS round-2: 4k proposals/s measured against a
+    4.45M/s serial C++ annealer; the design was batched but the loop was
+    sync-bound).  Once t has fallen below exit_t the remaining
+    temperatures no-op (t frozen at 0 accepts only improvements, and
+    srat-based updates are skipped), so a segment can overshoot the exit
+    criterion harmlessly.
+
+    Returns (pos, ring_idx, occ, t, rlim, na [n_temps], nv [n_temps],
+    bb [n_temps], td [n_temps])."""
+    rmax = jnp.float32(max(pp.nx, pp.ny))
+
+    def temp_body(carry, k):
+        pos, ring_idx, occ, t, rlim, done, bb_cost = carry
+        # bb_cost rides the carry: the exit cost of temperature k IS the
+        # entry cost of k+1, so each temperature pays ONE full bb
+        # reduction, not two
+        td_cost = (net_td_cost(pp, pos, crit) if timing
+                   else jnp.float32(1.0))
+        inv_bb = 1.0 / jnp.maximum(bb_cost, 1e-30)
+        inv_td = 1.0 / jnp.maximum(td_cost, 1e-30)
+        t_eff = jnp.where(done, 0.0, t)
+
+        def step(c2, kk):
+            pos, ring_idx, occ = c2
+            pos, ring_idx, occ, na, nv, _, _ = sa_step(
+                pp, pos, ring_idx, occ, crit, inv_bb, inv_td, tradeoff,
+                kk, t_eff, rlim, M, timing)
+            return (pos, ring_idx, occ), (na, nv)
+
+        keys = jax.random.split(k, steps)
+        (pos, ring_idx, occ), (nas, nvs) = jax.lax.scan(
+            step, (pos, ring_idx, occ), keys)
+        na = nas.sum()
+        nv = nvs.sum()
+        srat = na.astype(jnp.float32) / jnp.maximum(1, nv)
+        # update_t (place.c:265) on device
+        fac = jnp.where(srat > 0.96, 0.5,
+                        jnp.where(srat > 0.8, 0.9,
+                                  jnp.where((srat > 0.15) | (rlim > 1.0),
+                                            0.95, 0.8)))
+        t2 = jnp.where(done, t, t * fac)
+        rlim2 = jnp.where(done, rlim, jnp.clip(
+            rlim * (1.0 - 0.44 + srat), 1.0, rmax))
+        done2 = done | (t2 < exit_t)
+        bb2, _ = net_bb_cost(pp, pos)
+        return ((pos, ring_idx, occ, t2, rlim2, done2, bb2),
+                (na, nv, bb2, jnp.where(done, 0.0, 1.0), t, rlim))
+
+    bb0, _ = net_bb_cost(pp, pos)
+    keys = jax.random.split(key, n_temps)
+    (pos, ring_idx, occ, t, rlim, done, _), (na, nv, bb, live, ts, rls) = \
+        jax.lax.scan(temp_body,
+                     (pos, ring_idx, occ, t, rlim, jnp.bool_(False), bb0),
+                     keys)
+    return pos, ring_idx, occ, t, rlim, na, nv, bb, live, ts, rls
+
+
 class PlacerTiming:
     """Bundle wiring the placer to the timing subsystem: the delay-lookup
     matrices plus the STA machinery for criticality recomputation
@@ -579,46 +647,54 @@ class Placer:
         t = 20.0 * math.sqrt(max(var, 1e-12))
         rlim = float(max(pp.nx, pp.ny))
 
-        for temp_i in range(opts.max_temps):
-            if self.timing is not None and \
-                    temp_i % max(1, opts.recompute_crit_temps) == 0:
+        # segment size: with timing, criticalities must refresh every
+        # recompute_crit_temps temperatures (host STA round trip); pure
+        # wirelength anneals sync only once per SEG temperatures
+        exit_t = opts.exit_t_frac / max(1, NN)
+        SEG = (max(1, opts.recompute_crit_temps)
+               if self.timing is not None else 8)
+        temp_i = 0
+        while temp_i < opts.max_temps:
+            if self.timing is not None:
                 crit, _ = self._crit(np.asarray(pos))
-                td_cost = float(net_td_cost(pp, pos, crit))
-            inv_bb, inv_td = norms()
+            n_temps = min(SEG, opts.max_temps - temp_i)
             key, k = jax.random.split(key)
-            pos, ring, occ, na, nv, bbc, tdc = sa_temperature(
-                pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
-                jnp.float32(t), jnp.float32(rlim), M, steps,
+            (pos, ring, occ, t_d, rlim_d, na_a, nv_a, bb_a, live_a,
+             ts_a, rl_a) = sa_segment(
+                pp, pos, ring, occ, crit, tt, k,
+                jnp.float32(t), jnp.float32(rlim),
+                jnp.float32(exit_t), M, steps, n_temps,
                 self.timing is not None)
-            na, nv = int(na), int(nv)
-            bb_cost, td_cost = float(bbc), float(tdc)
-            srat = na / max(1, nv)
-            stats.temps.append((t, bb_cost, srat, rlim))
-            stats.total_moves += nv
-            # update_t / update_rlim (place.c:265)
-            if srat > 0.96:
-                t *= 0.5
-            elif srat > 0.8:
-                t *= 0.9
-            elif srat > 0.15 or rlim > 1.0:
-                t *= 0.95
-            else:
-                t *= 0.8
-            rlim = min(max(pp.nx, pp.ny),
-                       max(1.0, rlim * (1.0 - 0.44 + srat)))
-            # exit_crit (place.c:270) on the normalized combined cost (~1)
-            if t < opts.exit_t_frac / max(1, NN):
+            # ONE host sync per segment
+            t, rlim, na_a, nv_a, bb_a, live_a, ts_a, rl_a = \
+                jax.device_get((t_d, rlim_d, na_a, nv_a, bb_a, live_a,
+                                ts_a, rl_a))
+            t, rlim = float(t), float(rlim)
+            for i in range(n_temps):
+                if live_a[i] == 0.0:
+                    break
+                srat = int(na_a[i]) / max(1, int(nv_a[i]))
+                stats.temps.append((float(ts_a[i]), float(bb_a[i]), srat,
+                                    float(rl_a[i])))
+                stats.total_moves += int(nv_a[i])
+            temp_i += n_temps
+            bb_cost = float(bb_a[-1])
+            # exit_crit (place.c:270) on the normalized combined cost
+            if t < exit_t:
                 break
 
-        # final quench at t=0
+        # final quench at t=0 (via sa_segment so the cost normalization
+        # is computed fresh on device, not from pre-anneal values)
+        if self.timing is not None:
+            crit, _ = self._crit(np.asarray(pos))
         key, k = jax.random.split(key)
-        inv_bb, inv_td = norms()
-        pos, ring, occ, _, _, bbc, tdc = sa_temperature(
-            pp, pos, ring, occ, crit, inv_bb, inv_td, tt, k,
-            jnp.float32(0.0), jnp.float32(1.0), M, steps,
+        pos, ring, occ, _, _, _, _, bb_a, _, _, _ = sa_segment(
+            pp, pos, ring, occ, crit, tt, k, jnp.float32(0.0),
+            jnp.float32(1.0), jnp.float32(exit_t), M, steps, 1,
             self.timing is not None)
-        stats.final_cost = float(bbc)
-        stats.final_td_cost = float(tdc)
+        stats.final_cost = float(bb_a[-1])
+        stats.final_td_cost = float(net_td_cost(pp, pos, crit)) \
+            if self.timing is not None else 0.0
         if self.timing is not None:
             _, stats.est_crit_path = self._crit(np.asarray(pos))
         # final legality audit (check_place, place.c:253): an annealer

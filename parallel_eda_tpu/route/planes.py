@@ -1,0 +1,1004 @@
+"""Planes relaxation kernel: structured shortest-path search without gathers.
+
+The replacement for the ELL pull-relaxation of search.py (_relax): instead of
+[B, N, D] gathers over an arbitrary edge table, the router state is laid out
+as dense per-direction wire grids ("planes") co-designed with the rr
+builder's regular channel structure (rr/graph.py):
+
+    dx [B, W, NX, NY+1]   the CHANX wire covering (track t, x, y)
+    dy [B, W, NX+1, NY]   the CHANY wire covering (track t, x, y)
+
+Every wire relaxation is a structured tensor op:
+
+  * straight continuation along a channel row — one min-plus ASSOCIATIVE
+    SCAN per direction: s[x] = min(d0[x], s[x-1] + c[x]), where c[x] pays
+    the switch delay + PathFinder congestion cost only at span breaks (the
+    builder's staggered length-L wire spans are static break masks).  One
+    scan propagates a whole row, so the relaxation converges in O(#turns)
+    sweeps instead of O(path length).
+  * switchbox turns — shifted masked mins between the dx/dy canvases; the
+    builder's rotated-subset pattern (CHANX t <-> CHANY (t+1+parity) mod W)
+    is literally a jnp.roll along the track axis with a checkerboard parity
+    mask.
+  * terminal hops (SOURCE->OPIN->wire, wire->IPIN->SINK) — small per-net
+    tables, outside the sweep loop entirely: pins are only ever endpoints
+    (OPIN is reachable only from SOURCE, IPIN leads only to SINK), so the
+    sweeps never need pin planes.
+
+Alongside the distance, every relaxation step tracks the IMMEDIATE
+PREDECESSOR CELL and the true (un-weighted) delay of the entering edge, as
+elementwise payloads of the same scans/shifts.  Traceback is then a pure
+pointer chase over `pred` with take_along_axis — the one dynamic-access
+pattern that is fast on this backend.  (Measured on the tunneled v5e: a
+chain of 110 dependent [B, G]-from-[B, Ncells] take_alongs costs ~0.03 ms,
+while anything touching the [N, D] ELL rows in a loop — row gathers,
+flattened takes, even one-hot matmuls — pays a ~65 ms penalty per program.
+The entire batch step below therefore uses ONLY elementwise ops, scans,
+rolls, scatters, and take_along gathers.)
+
+The pred chase cannot cycle: every strict improvement re-sets (dist, pred,
+w) atomically and dist is monotone non-increasing, so d(pred(x)) < d(x)
+along any snapshot chain (ties never update), and walks terminate at a
+pred==self cell — a tree seed or a SOURCE-side entry.
+
+This is the round-3 answer to the reference's heap-search work-efficiency
+(vpr/SRC/parallel_route/dijkstra.h:15, route_timing.c:603
+timing_driven_expand_neighbours).  Cost model, seeding semantics, jitter,
+and the congestion view are shared with search.py
+(congestion_cost_arrays), so the negotiation is identical.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import lax
+
+from ..rr.graph import CHANX, CHANY, RRGraph
+from .device_graph import DeviceRRGraph
+from .search import JITTER_EPS, congestion_cost, usage_from_paths
+
+INF = jnp.inf
+
+
+# ---------------------------------------------------------------------------
+# Static plane metadata (host build, once per Router)
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class PlanesGraph:
+    """Static per-graph plane layout + masks (device arrays, pytree).
+
+    Cell space: every (track, x, y) channel position is a cell; a length-L
+    wire owns L cells.  chanx cells [W, NX, NY+1] flattened first, then
+    chany cells [W, NX+1, NY]; `ncells` total.
+    """
+    node_of_cell: jnp.ndarray       # int32 [Ncells] rr-node id of each cell
+    cell_of_node: jnp.ndarray       # int32 [N] representative cell
+    #                                 (non-wire nodes -> Ncells = INF pad)
+    # span-break masks (x axis for chanx, y axis for chany)
+    brk_before_x: jnp.ndarray       # bool [W, NX, NY+1]
+    brk_after_x: jnp.ndarray
+    brk_before_y: jnp.ndarray       # bool [W, NX+1, NY]
+    brk_after_y: jnp.ndarray
+    # span endpoint masks (for the endpoint-gated switchbox rule)
+    first_x: jnp.ndarray            # bool: cell is its node's span start
+    last_x: jnp.ndarray
+    first_y: jnp.ndarray
+    last_y: jnp.ndarray
+    # enter-delay planes: delay of an edge INTO this cell's node
+    #   delay_x / delay_y: switch = wire_switch of the cell's own track
+    #   (straight continuation, same-index turns, rotated turns into CHANX)
+    delay_x: jnp.ndarray            # f32 [W, NX, NY+1]
+    delay_y: jnp.ndarray            # f32 [W, NX+1, NY]
+    #   rotated turns into CHANY use the SOURCE track's switch
+    #   (rr/graph.py adds both rotated directions with the chanx track's
+    #   switch): delay with wire_switch of track (t - 1 - parity) mod W
+    delay_y_rot0: jnp.ndarray       # f32 [W, NX+1, NY] (parity 0)
+    delay_y_rot1: jnp.ndarray       # f32 [W, NX+1, NY] (parity 1)
+
+    @property
+    def shape_x(self):
+        return self.brk_before_x.shape      # (W, NX, NY+1)
+
+    @property
+    def shape_y(self):
+        return self.brk_before_y.shape      # (W, NX+1, NY)
+
+    @property
+    def ncells(self) -> int:
+        sx, sy = self.shape_x, self.shape_y
+        return int(np.prod(sx) + np.prod(sy))
+
+
+def _cover_cells(ids, t, lo, hi, fixed, horizontal, W, NX, NY):
+    """Flat cell indices covered by wire spans (vectorized arange trick)."""
+    reps = (hi - lo + 1).astype(np.int64)
+    total = int(reps.sum())
+    node_rep = np.repeat(ids, reps)
+    t_rep = np.repeat(t, reps).astype(np.int64)
+    f_rep = np.repeat(fixed, reps).astype(np.int64)
+    starts = np.repeat(np.cumsum(reps) - reps, reps)
+    pos = np.repeat(lo, reps).astype(np.int64) + (np.arange(total) - starts)
+    if horizontal:      # chanx: (t, x=pos in 1..NX, y=fixed in 0..NY)
+        cell = (t_rep * NX + (pos - 1)) * (NY + 1) + f_rep
+    else:               # chany: (t, x=fixed in 0..NX, y=pos in 1..NY)
+        cell = (t_rep * (NX + 1) + f_rep) * NY + (pos - 1)
+    return node_rep, cell
+
+
+def build_planes(rr: RRGraph) -> PlanesGraph:
+    """Derive the plane layout from a built RRGraph.  Requires the builder's
+    per-track switch map (rr.wire_switch_of_track)."""
+    if rr.wire_switch_of_track is None:
+        raise ValueError("planes need rr.wire_switch_of_track "
+                         "(graph not built by rr.graph.build_rr_graph)")
+    W = rr.chan_width
+    NX, NY = rr.grid.nx, rr.grid.ny
+    N = rr.num_nodes
+    ncx = W * NX * (NY + 1)
+    ncy = W * (NX + 1) * NY
+    ncells = ncx + ncy
+
+    node_of_cell = np.full(ncells, N, dtype=np.int64)
+    is_x = rr.node_type == CHANX
+    is_y = rr.node_type == CHANY
+    idx = np.where(is_x)[0]
+    nrep, cell = _cover_cells(idx, rr.ptc[idx], rr.xlow[idx], rr.xhigh[idx],
+                              rr.ylow[idx], True, W, NX, NY)
+    node_of_cell[cell] = nrep
+    idy = np.where(is_y)[0]
+    nrep, cell = _cover_cells(idy, rr.ptc[idy], rr.ylow[idy], rr.yhigh[idy],
+                              rr.xlow[idy], False, W, NX, NY)
+    node_of_cell[ncx + cell] = nrep
+    assert (node_of_cell < N).all(), "uncovered channel cell"
+
+    cell_of_node = np.full(N + 1, ncells, dtype=np.int64)
+    # first covered cell of each node (reverse write keeps the lowest)
+    order = np.arange(ncells - 1, -1, -1)
+    cell_of_node[node_of_cell[order]] = order
+    cell_of_node = cell_of_node[:N]
+
+    nx_pl = node_of_cell[:ncx].reshape(W, NX, NY + 1)
+    ny_pl = node_of_cell[ncx:].reshape(W, NX + 1, NY)
+
+    def breaks(pl, axis):
+        d = np.diff(pl, axis=axis) != 0
+        pad = np.ones(tuple(1 if a == axis else s
+                            for a, s in enumerate(pl.shape)), dtype=bool)
+        before = np.concatenate([pad, d], axis=axis)
+        after = np.concatenate([d, pad], axis=axis)
+        return before, after
+
+    brk_before_x, brk_after_x = breaks(nx_pl, 1)
+    brk_before_y, brk_after_y = breaks(ny_pl, 2)
+
+    xcoord = np.arange(1, NX + 1)[None, :, None]
+    ycoord = np.arange(1, NY + 1)[None, None, :]
+    first_x = rr.xlow[nx_pl] == xcoord
+    last_x = rr.xhigh[nx_pl] == xcoord
+    first_y = rr.ylow[ny_pl] == ycoord
+    last_y = rr.yhigh[ny_pl] == ycoord
+
+    # enter-delay planes: Tdel[sw] + C[node]*(R[sw] + R[node]/2) — the
+    # exact in_delay formula of the builder (rr/graph.py in_delay)
+    def enter_delay(pl, sw_of_t):
+        Csw = rr.C[pl]
+        Rsw = rr.R[pl]
+        tdel = rr.switch_Tdel[sw_of_t][:, None, None]
+        rs = rr.switch_R[sw_of_t][:, None, None]
+        return (tdel + Csw * (rs + 0.5 * Rsw)).astype(np.float32)
+
+    swt = rr.wire_switch_of_track.astype(np.int64)
+    delay_x = enter_delay(nx_pl, swt)
+    delay_y = enter_delay(ny_pl, swt)
+    rot0 = swt[(np.arange(W) - 1) % W]       # parity 0: src = (t-1) mod W
+    rot1 = swt[(np.arange(W) - 2) % W]       # parity 1: src = (t-2) mod W
+    delay_y_rot0 = enter_delay(ny_pl, rot0)
+    delay_y_rot1 = enter_delay(ny_pl, rot1)
+
+    j = jnp.asarray
+    return PlanesGraph(
+        node_of_cell=j(node_of_cell, dtype=jnp.int32),
+        cell_of_node=j(cell_of_node, dtype=jnp.int32),
+        brk_before_x=j(brk_before_x), brk_after_x=j(brk_after_x),
+        brk_before_y=j(brk_before_y), brk_after_y=j(brk_after_y),
+        first_x=j(first_x), last_x=j(last_x),
+        first_y=j(first_y), last_y=j(last_y),
+        delay_x=j(delay_x), delay_y=j(delay_y),
+        delay_y_rot0=j(delay_y_rot0), delay_y_rot1=j(delay_y_rot1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-route-call terminal tables (host build; exact edge enumeration from
+# the graph — the net_t source/sink expansion of route.h:70)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlanesTerminals:
+    """Per-net terminal entry tables.
+
+    SOURCE side: the net's source-class OPINs and every OPIN->wire edge as
+    (wire cell, opin index, exact edge delay).  SINK side: every
+    (wire -> IPIN -> SINK) two-edge hop as (wire cell, ipin node, exact
+    total delay).  All host numpy; the Router uploads them once per
+    route() call and keeps them device-resident."""
+    opin_node: np.ndarray       # int32 [R, O] source-class OPINs (pad N)
+    entry_cell: np.ndarray      # int32 [R, Ko] wire cell (pad Ncells)
+    entry_oidx: np.ndarray      # int32 [R, Ko] index into opin_node (pad 0)
+    entry_delay: np.ndarray     # f32  [R, Ko] edge delay OPIN -> wire
+    sink_cell: np.ndarray       # int32 [R, S, K] wire cell (pad Ncells)
+    sink_ipin: np.ndarray       # int32 [R, S, K] IPIN node (pad N)
+    sink_delay: np.ndarray      # f32  [R, S, K] delay wire->IPIN->SINK
+
+
+def build_planes_terminals(rr: RRGraph, source: np.ndarray,
+                           sinks: np.ndarray, cell_of_node: np.ndarray,
+                           ncells: int) -> PlanesTerminals:
+    """source [R], sinks [R, S] (-1 pad) -> terminal tables.  `ncells` is
+    the table pad value (one past the last real cell: the batch step pads
+    its dist arrays with one INF slot there — out-of-range pads would hit
+    take_along_axis's NaN fill and poison every argmin)."""
+    R = len(source)
+    S = sinks.shape[1]
+    N = rr.num_nodes
+
+    orp, odst, osw = rr.out_row_ptr, rr.out_dst, rr.out_switch
+    irp, isrc, idel = rr.in_row_ptr, rr.in_src, rr.in_delay
+
+    opins_per_net, entries_per_net = [], []
+    for r in range(R):
+        s = int(source[r])
+        ops = odst[orp[s]:orp[s + 1]]
+        ents = []
+        for oi, o in enumerate(ops):
+            lo, hi = orp[o], orp[o + 1]
+            wires = odst[lo:hi]
+            esw = osw[lo:hi].astype(np.int64)
+            d = (rr.switch_Tdel[esw] + rr.C[wires]
+                 * (rr.switch_R[esw] + 0.5 * rr.R[wires]))
+            for w, dd in zip(wires, d):
+                ents.append((int(cell_of_node[w]), oi, float(dd)))
+        opins_per_net.append(ops)
+        entries_per_net.append(ents)
+
+    # sink side: cache per sink NODE (shared classes repeat across nets)
+    cache = {}
+
+    def sink_cands(sk):
+        if sk in cache:
+            return cache[sk]
+        out = []
+        for e in range(irp[sk], irp[sk + 1]):
+            ip = int(isrc[e])
+            w1 = float(idel[e])
+            for e2 in range(irp[ip], irp[ip + 1]):
+                wire = int(isrc[e2])
+                out.append((int(cell_of_node[wire]), ip,
+                            w1 + float(idel[e2])))
+        cache[sk] = out
+        return out
+
+    O = max(1, max(len(o) for o in opins_per_net))
+    Ko = max(1, max(len(e) for e in entries_per_net))
+    K = 1
+    for r in range(R):
+        for s in range(S):
+            if sinks[r, s] >= 0:
+                K = max(K, len(sink_cands(int(sinks[r, s]))))
+
+    opin_node = np.full((R, O), N, dtype=np.int32)
+    entry_cell = np.full((R, Ko), ncells, dtype=np.int32)
+    entry_oidx = np.zeros((R, Ko), dtype=np.int32)
+    entry_delay = np.zeros((R, Ko), dtype=np.float32)
+    sink_cell = np.full((R, S, K), ncells, dtype=np.int32)
+    sink_ipin = np.full((R, S, K), N, dtype=np.int32)
+    sink_delay = np.zeros((R, S, K), dtype=np.float32)
+    for r in range(R):
+        ops, ents = opins_per_net[r], entries_per_net[r]
+        opin_node[r, :len(ops)] = ops
+        for k, (c, oi, dd) in enumerate(ents):
+            entry_cell[r, k] = c
+            entry_oidx[r, k] = oi
+            entry_delay[r, k] = dd
+        for s in range(S):
+            if sinks[r, s] < 0:
+                continue
+            for k, (c, ip, dd) in enumerate(sink_cands(int(sinks[r, s]))):
+                sink_cell[r, s, k] = c
+                sink_ipin[r, s, k] = ip
+                sink_delay[r, s, k] = dd
+    return PlanesTerminals(opin_node, entry_cell, entry_oidx, entry_delay,
+                           sink_cell, sink_ipin, sink_delay)
+
+
+
+
+# ---------------------------------------------------------------------------
+# The relaxation: min-plus scans + turn shifts, with (pred, wenter) payload
+# ---------------------------------------------------------------------------
+
+
+def _minplus_scan(d0, c, axis, reverse=False):
+    """s[x] = min(d0[x], s[x-1] + c[x]) along axis (reverse: x+1 side).
+
+    First-order (min, +) recurrence via associative_scan on pairs:
+    combine((c1, m1), (c2, m2)) = (c1 + c2, min(m1 + c2, m2))."""
+    def comb(a, b):
+        ca, ma = a
+        cb, mb = b
+        return ca + cb, jnp.minimum(ma + cb, mb)
+
+    if reverse:
+        d0 = jnp.flip(d0, axis)
+        c = jnp.flip(c, axis)
+    _, s = lax.associative_scan(comb, (c, d0), axis=axis)
+    if reverse:
+        s = jnp.flip(s, axis)
+    return s
+
+
+def _scan_update(d, pred, w, cstep, wstep, self_idx, stride, axis,
+                 reverse):
+    """Run one directional scan and fold (dist, pred, wenter): improved
+    cells point at the immediate neighbor in the scan direction."""
+    s = _minplus_scan(d, cstep, axis, reverse)
+    imp = s < d
+    nb = self_idx + (stride if reverse else -stride)
+    return (jnp.where(imp, s, d),
+            jnp.where(imp, nb, pred),
+            jnp.where(imp, wstep, w))
+
+
+def _turn_triples_into_y(pg: PlanesGraph, dx, idxx_canvas, crit_c, cc_y):
+    """Best switchbox-turn candidate INTO each chany cell from dx.
+
+    Returns (val, src, w): [B, W, NX+1, NY] candidate cost, global source
+    cell index, true enter delay.  For target chany cell (t', x, v),
+    contributions come from chanx cells (x+a, v-b), a,b in {0,1}, at
+    corner (x, v-b); the edge exists iff the source cell ends at the
+    corner (a=0: last_x, a=1: first_x) OR the target does (b=0: last_y,
+    b=1: first_y).  Rotated turns take t = (t'-1-parity) mod W with
+    parity = (x + v - b) mod 2 — a roll along the track axis applied
+    identically to the value and index canvases."""
+    B = dx.shape[0]
+    W, NX, NYp1 = pg.shape_x
+    NY = NYp1 - 1
+
+    def canvas_x(a, fill):
+        c = jnp.full((B, W, NX + 2, NY + 2), fill, a.dtype)
+        return c.at[:, :, 1:NX + 1, 0:NY + 1].set(a)
+
+    def canvas_ix(a, fill):
+        c = jnp.full((W, NX + 2, NY + 2), fill, a.dtype)
+        return c.at[:, 1:NX + 1, 0:NY + 1].set(a)
+
+    cx_all = canvas_x(dx, INF)
+    cx_last = canvas_x(jnp.where(pg.last_x, dx, INF), INF)
+    cx_first = canvas_x(jnp.where(pg.first_x, dx, INF), INF)
+    ix = canvas_ix(idxx_canvas, jnp.int32(0))
+
+    xg = jnp.arange(NX + 1)[:, None]
+    best = jnp.full((B, W, NX + 1, NY), INF, dx.dtype)
+    bsrc = jnp.zeros((B, W, NX + 1, NY), jnp.int32)
+    bw = jnp.zeros((B, W, NX + 1, NY), jnp.float32)
+
+    def fold(best, bsrc, bw, cand, src, w):
+        better = cand < best
+        return (jnp.where(better, cand, best),
+                jnp.where(better, src, bsrc),
+                jnp.where(better, w, bw))
+
+    for b_off in (0, 1):
+        tgt_gate = pg.last_y if b_off == 0 else pg.first_y
+        par = (xg + (jnp.arange(1, NY + 1)[None, :] - b_off)) % 2
+        for a_off in (0, 1):
+            src_gated = cx_last if a_off == 0 else cx_first
+            sl = (slice(None), slice(None),
+                  slice(a_off, a_off + NX + 1),
+                  slice(1 - b_off, 1 - b_off + NY))
+            sli = (slice(None),) + sl[2:]
+            v_any = cx_all[sl]
+            v_src = src_gated[sl]
+            src_i = ix[sli][None]
+            cand = jnp.minimum(v_src, jnp.where(tgt_gate, v_any, INF))
+            cand = cand + crit_c * pg.delay_y + cc_y
+            best, bsrc, bw = fold(best, bsrc, bw, cand, src_i, pg.delay_y)
+            for p in (0, 1):
+                if (1 + p) % W == 0:
+                    continue
+                r_all = jnp.roll(cx_all, 1 + p, axis=1)[sl]
+                r_src = jnp.roll(src_gated, 1 + p, axis=1)[sl]
+                r_i = jnp.roll(ix, 1 + p, axis=0)[sli][None]
+                dly = pg.delay_y_rot0 if p == 0 else pg.delay_y_rot1
+                cand = jnp.minimum(r_src, jnp.where(tgt_gate, r_all, INF))
+                cand = cand + crit_c * dly + cc_y
+                cand = jnp.where(par[None, None] == p, cand, INF)
+                best, bsrc, bw = fold(best, bsrc, bw, cand, r_i, dly)
+    return best, bsrc, bw
+
+
+def _turn_triples_into_x(pg: PlanesGraph, dy, idxy_canvas, crit_c, cc_x):
+    """Mirror of _turn_triples_into_y: candidates INTO the chanx plane.
+    Target chanx cell (t, u, y) receives from chany cells (u-a, y+b) at
+    corner (u-a, y); gates: src b=0: last_y, b=1: first_y; tgt a=0:
+    last_x, a=1: first_x.  Rotated source track is (t+1+parity) mod W with
+    parity = (u-a+y) mod 2; both rotated directions use the CHANX track's
+    switch (delay_x, see rr/graph.py edge emission)."""
+    B = dy.shape[0]
+    W, NXp1, NY = pg.shape_y
+    NX = NXp1 - 1
+
+    def canvas_y(a, fill):
+        c = jnp.full((B, W, NX + 2, NY + 2), fill, a.dtype)
+        return c.at[:, :, 0:NX + 1, 1:NY + 1].set(a)
+
+    def canvas_iy(a, fill):
+        c = jnp.full((W, NX + 2, NY + 2), fill, a.dtype)
+        return c.at[:, 0:NX + 1, 1:NY + 1].set(a)
+
+    cy_all = canvas_y(dy, INF)
+    cy_last = canvas_y(jnp.where(pg.last_y, dy, INF), INF)
+    cy_first = canvas_y(jnp.where(pg.first_y, dy, INF), INF)
+    iy = canvas_iy(idxy_canvas, jnp.int32(0))
+
+    yg = jnp.arange(NY + 1)[None, :]
+    best = jnp.full((B, W, NX, NY + 1), INF, dy.dtype)
+    bsrc = jnp.zeros((B, W, NX, NY + 1), jnp.int32)
+    bw = jnp.zeros((B, W, NX, NY + 1), jnp.float32)
+
+    def fold(best, bsrc, bw, cand, src, w):
+        better = cand < best
+        return (jnp.where(better, cand, best),
+                jnp.where(better, src, bsrc),
+                jnp.where(better, w, bw))
+
+    for a_off in (0, 1):
+        tgt_gate = pg.last_x if a_off == 0 else pg.first_x
+        par = ((jnp.arange(1, NX + 1)[:, None] - a_off) + yg) % 2
+        for b_off in (0, 1):
+            src_gated = cy_last if b_off == 0 else cy_first
+            sl = (slice(None), slice(None),
+                  slice(1 - a_off, 1 - a_off + NX),
+                  slice(b_off, b_off + NY + 1))
+            sli = (slice(None),) + sl[2:]
+            v_any = cy_all[sl]
+            v_src = src_gated[sl]
+            src_i = iy[sli][None]
+            cand = jnp.minimum(v_src, jnp.where(tgt_gate, v_any, INF))
+            cand = cand + crit_c * pg.delay_x + cc_x
+            best, bsrc, bw = fold(best, bsrc, bw, cand, src_i, pg.delay_x)
+            for p in (0, 1):
+                if (1 + p) % W == 0:
+                    continue
+                r_all = jnp.roll(cy_all, -(1 + p), axis=1)[sl]
+                r_src = jnp.roll(src_gated, -(1 + p), axis=1)[sl]
+                r_i = jnp.roll(iy, -(1 + p), axis=0)[sli][None]
+                cand = jnp.minimum(r_src, jnp.where(tgt_gate, r_all, INF))
+                cand = cand + crit_c * pg.delay_x + cc_x
+                cand = jnp.where(par[None, None] == p, cand, INF)
+                best, bsrc, bw = fold(best, bsrc, bw, cand, r_i,
+                                      pg.delay_x)
+    return best, bsrc, bw
+
+
+def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
+                 nsweeps: int):
+    """Fixed-sweep planes relaxation with predecessor tracking.
+
+    d0_flat [B, Ncells] seeded initial distances (pred of a seeded cell is
+    itself — the walk's stop condition); cc_flat congestion cost per cell
+    (already (1-crit)-scaled, jittered, INF outside the net bb); crit_c
+    [B, 1, 1, 1]; wenter0 [B, Ncells] true delay payload at seeds (entry
+    edge delay for SOURCE-side entries, 0 for tree cells).
+
+    The sweep count is STATIC (lax.fori_loop): on the tunneled backend a
+    data-dependent while_loop pays a ~65 ms per-program penalty while
+    fixed-trip loops are free; the Router sizes nsweeps from the batch's
+    bounding boxes (one sweep spans a whole row, so #turns+1 sweeps
+    suffice) and relies on the unreached-sink widening retry as the
+    safety net.
+
+    Returns (dist_flat, pred_flat, wenter_flat)."""
+    B = d0_flat.shape[0]
+    W, NX, NYp1 = pg.shape_x
+    _, NXp1, NY = pg.shape_y
+    ncx = W * NX * NYp1
+
+    dx = d0_flat[:, :ncx].reshape(B, W, NX, NYp1)
+    dy = d0_flat[:, ncx:].reshape(B, W, NXp1, NY)
+    cc_x = cc_flat[:, :ncx].reshape(B, W, NX, NYp1)
+    cc_y = cc_flat[:, ncx:].reshape(B, W, NXp1, NY)
+
+    idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(W, NX, NYp1)
+    idxy = (ncx + jnp.arange(W * NXp1 * NY, dtype=jnp.int32)
+            ).reshape(W, NXp1, NY)
+    predx = jnp.broadcast_to(idxx[None], dx.shape)
+    predy = jnp.broadcast_to(idxy[None], dy.shape)
+    wx = wenter0[:, :ncx].reshape(B, W, NX, NYp1)
+    wy = wenter0[:, ncx:].reshape(B, W, NXp1, NY)
+
+    # scan step costs: pay switch delay + congestion only at span breaks
+    cfx = jnp.where(pg.brk_before_x, crit_c * pg.delay_x + cc_x, 0.0)
+    cbx = jnp.where(pg.brk_after_x, crit_c * pg.delay_x + cc_x, 0.0)
+    cfy = jnp.where(pg.brk_before_y, crit_c * pg.delay_y + cc_y, 0.0)
+    cby = jnp.where(pg.brk_after_y, crit_c * pg.delay_y + cc_y, 0.0)
+    wfx = jnp.where(pg.brk_before_x, pg.delay_x, 0.0)
+    wbx = jnp.where(pg.brk_after_x, pg.delay_x, 0.0)
+    wfy = jnp.where(pg.brk_before_y, pg.delay_y, 0.0)
+    wby = jnp.where(pg.brk_after_y, pg.delay_y, 0.0)
+
+    def sweep(_, s):
+        dx, dy, predx, predy, wx, wy = s
+        dx, predx, wx = _scan_update(dx, predx, wx, cfx, wfx, idxx[None],
+                                     NYp1, 2, False)
+        dx, predx, wx = _scan_update(dx, predx, wx, cbx, wbx, idxx[None],
+                                     NYp1, 2, True)
+        tv, ts, tw = _turn_triples_into_y(pg, dx, idxx, crit_c, cc_y)
+        imp = tv < dy
+        dy = jnp.where(imp, tv, dy)
+        predy = jnp.where(imp, ts, predy)
+        wy = jnp.where(imp, tw, wy)
+        dy, predy, wy = _scan_update(dy, predy, wy, cfy, wfy, idxy[None],
+                                     1, 3, False)
+        dy, predy, wy = _scan_update(dy, predy, wy, cby, wby, idxy[None],
+                                     1, 3, True)
+        tv, ts, tw = _turn_triples_into_x(pg, dy, idxy, crit_c, cc_x)
+        imp = tv < dx
+        dx = jnp.where(imp, tv, dx)
+        predx = jnp.where(imp, ts, predx)
+        wx = jnp.where(imp, tw, wx)
+        return dx, dy, predx, predy, wx, wy
+
+    dx, dy, predx, predy, wx, wy = lax.fori_loop(
+        0, nsweeps, sweep, (dx, dy, predx, predy, wx, wy))
+
+    def flat(a, b):
+        return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
+                               axis=1)
+
+    return flat(dx, dy), flat(predx, predy), flat(wx, wy)
+
+
+# ---------------------------------------------------------------------------
+# The fused batch step (device-resident contract of
+# search.route_batch_resident, planes search inside, zero slow-class ops)
+# ---------------------------------------------------------------------------
+
+
+def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
+               paths, sink_delay, all_reached, bb,
+               source_all, sinks_all, crit_all,
+               opin_node_all, entry_cell_all, entry_oidx_all,
+               entry_delay_all,
+               sink_cell_all, sink_ipin_all, sink_wdelay_all,
+               sel, valid, force, full_bb,
+               nsweeps: int, max_len: int, num_waves: int, group: int,
+               doubling: bool, mesh):
+    """One fused batch step (traceable body shared by the standalone
+    per-batch wrapper and the window program): rip up the selected nets,
+    re-route each against the occupancy view of everyone-but-itself with
+    the planes kernel, commit, scatter back.  A selected net is a no-op
+    unless it needs rerouting (an overused node on its tree or an
+    unreached sink — route_timing.c should_route_net semantics) or
+    `force` is true, so a static batch plan can cover all nets every
+    iteration and the device skips the clean ones."""
+    N = dev.num_nodes
+    R = paths.shape[0]
+    B = sel.shape[0]
+    S = sinks_all.shape[1]
+    ncells = pg.ncells
+    Kw = max_len - 4            # walk budget: sink+ipin+opin+source slots
+
+    b_paths = paths[sel]
+    b_src = source_all[sel]
+    b_sinks = sinks_all[sel]
+    b_bb = bb[sel]
+    b_crit = crit_all[sel]
+    b_opin = opin_node_all[sel]                  # [B, O]
+    b_ecell = entry_cell_all[sel]                # [B, Ko]
+    b_eoidx = entry_oidx_all[sel]
+    b_edelay = entry_delay_all[sel]
+    b_scell = sink_cell_all[sel]                 # [B, S, K]
+    b_sipin = sink_ipin_all[sel]
+    b_swdel = sink_wdelay_all[sel]
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def c(x, *spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+        b_paths = c(b_paths, "net", None, None)
+        b_src = c(b_src, "net")
+        b_sinks = c(b_sinks, "net", None)
+        b_bb = c(b_bb, "net", None)
+        b_crit = c(b_crit, "net", None)
+        b_opin = c(b_opin, "net", None)
+        b_ecell = c(b_ecell, "net", None)
+        b_eoidx = c(b_eoidx, "net", None)
+        b_edelay = c(b_edelay, "net", None)
+        b_scell = c(b_scell, "net", None, None)
+        b_sipin = c(b_sipin, "net", None, None)
+        b_swdel = c(b_swdel, "net", None, None)
+
+    arangeB = jnp.arange(B)
+    O = b_opin.shape[1]
+    Ko = b_ecell.shape[1]
+    K = b_scell.shape[2]
+
+    # device-side reroute predicate: skip clean nets unless forced
+    over_now = jnp.append(occ > dev.capacity, False)
+    dirty = over_now[b_paths].any(axis=(1, 2)) | ~all_reached[sel]
+    valid = valid & (dirty | force)
+
+    # --- rip up (identical to the ELL resident program) ---
+    nodes_p1 = jnp.zeros(N + 1, dtype=jnp.float32)
+    old_usage = usage_from_paths(b_paths, nodes_p1) & valid[:, None]
+    occ_rip = occ - jnp.sum(old_usage, axis=0, dtype=jnp.int32)
+    occ_view = occ[None, :] - old_usage.astype(jnp.int32)
+
+    cong = congestion_cost(dev, occ_view, acc, pres_fac)      # [B, N]
+    # deterministic per-(net, node) jitter — same hash as search.py so the
+    # two programs negotiate identically
+    h = (sel.astype(jnp.int32)[:, None] * jnp.int32(2654435761 & 0x7FFFFFFF)
+         + jnp.arange(N, dtype=jnp.int32)[None, :] * jnp.int32(40503))
+    jitter = 1.0 + JITTER_EPS * ((h & 0xFFFF).astype(jnp.float32) / 65536.0)
+    inside = ((dev.xhigh[None, :] >= b_bb[:, 0, None])
+              & (dev.xlow[None, :] <= b_bb[:, 1, None])
+              & (dev.yhigh[None, :] >= b_bb[:, 2, None])
+              & (dev.ylow[None, :] <= b_bb[:, 3, None]))
+    congj = jnp.where(inside, cong * jitter, INF)             # [B, N]
+    congj_p1 = jnp.concatenate(
+        [congj, jnp.full((B, 1), INF, jnp.float32)], axis=1)
+    noc_b = jnp.broadcast_to(pg.node_of_cell[None, :], (B, ncells))
+    cc_flat_base = jnp.take_along_axis(congj_p1, noc_b, axis=1)
+    opin_congj = jnp.take_along_axis(
+        congj_p1, jnp.clip(b_opin, 0, N), axis=1)              # [B, O]
+    ipin_congj = jnp.take_along_axis(
+        congj_p1, b_sipin.reshape(B, -1), axis=1).reshape(B, S, K)
+
+    # initial tree: empty in cell space; SOURCE entries come via opin_du
+    seed0 = jnp.zeros((B, ncells), bool)
+
+    def wave_body(wave, state):
+        (seed_cells, tdel_cells, opin_used, remaining, wpaths, delay,
+         reached_all) = state
+        crit_w = jnp.max(jnp.where(remaining, b_crit, 0.0), axis=1)  # [B]
+        cw = 1.0 - crit_w
+        cc_flat = cw[:, None] * cc_flat_base
+        crit_c = crit_w[:, None, None, None]
+
+        # --- seed + SOURCE-side entries ---
+        d_seed = jnp.where(seed_cells, 0.0, INF)
+        opin_du = jnp.where(opin_used, 0.0, cw[:, None] * opin_congj)
+        e_du = jnp.take_along_axis(opin_du, b_eoidx, axis=1)   # [B, Ko]
+        cc_flat_p1 = jnp.concatenate(
+            [cc_flat, jnp.full((B, 1), INF)], axis=1)
+        e_cc = jnp.take_along_axis(cc_flat_p1,
+                                   jnp.minimum(b_ecell, ncells), axis=1)
+        e_cost = e_du + crit_w[:, None] * b_edelay + e_cc
+        d0 = d_seed.at[arangeB[:, None], b_ecell].min(e_cost, mode="drop")
+        entry_flag = d0 < d_seed                               # [B, Ncells]
+        # winning entry index per cell (ties -> lowest k, deterministic)
+        d0_at_e = jnp.take_along_axis(
+            jnp.concatenate([d0, jnp.full((B, 1), INF)], axis=1),
+            jnp.minimum(b_ecell, ncells), axis=1)
+        e_won = d0_at_e == e_cost
+        wk = jnp.full((B, ncells), Ko, jnp.int32).at[
+            arangeB[:, None], b_ecell].min(
+            jnp.where(e_won, jnp.arange(Ko, dtype=jnp.int32)[None, :], Ko),
+            mode="drop")
+        edelay_p1 = jnp.concatenate(
+            [b_edelay, jnp.zeros((B, 1))], axis=1)
+        wenter0 = jnp.where(
+            entry_flag,
+            jnp.take_along_axis(edelay_p1, jnp.minimum(wk, Ko), axis=1),
+            0.0)
+
+        dist, pred, wenter = planes_relax(pg, d0, cc_flat, crit_c,
+                                          wenter0, nsweeps)
+
+        # --- sink extraction from the per-net candidate tables ---
+        dist_p1 = jnp.concatenate([dist, jnp.full((B, 1), INF)], axis=1)
+        cand = (jnp.take_along_axis(
+            dist_p1, b_scell.reshape(B, -1), axis=1).reshape(B, S, K)
+            + crit_w[:, None, None] * b_swdel
+            + cw[:, None, None] * ipin_congj)
+        kstar = jnp.argmin(cand, axis=2)                       # [B, S]
+        sink_dist = jnp.take_along_axis(cand, kstar[:, :, None],
+                                        axis=2)[:, :, 0]
+        ent_cell = jnp.take_along_axis(b_scell, kstar[:, :, None],
+                                       axis=2)[:, :, 0]
+        ent_ipin = jnp.take_along_axis(b_sipin, kstar[:, :, None],
+                                       axis=2)[:, :, 0]
+        ent_wdel = jnp.take_along_axis(b_swdel, kstar[:, :, None],
+                                       axis=2)[:, :, 0]
+
+        # --- pick up to `group` sinks: most critical, then nearest ---
+        score = jnp.where(remaining & jnp.isfinite(sink_dist),
+                          sink_dist - b_crit * 1e3, INF)
+        order = jnp.argsort(score, axis=1)[:, :group]          # [B, G]
+        pick_valid = (jnp.take_along_axis(remaining, order, axis=1)
+                      & jnp.isfinite(jnp.take_along_axis(score, order,
+                                                         axis=1)))
+        if doubling:
+            # doubling schedule: wave k routes <= 2^k sinks, so a trunk
+            # forms before the bulk fan-out (the all-at-once variant
+            # costs ~20% wirelength, measured; this costs ~3%)
+            limit = jnp.int32(1) << jnp.minimum(wave, 30)
+            pick_valid = pick_valid & (jnp.arange(group)[None, :] < limit)
+        G = group
+        pick_sink = jnp.where(
+            pick_valid, jnp.take_along_axis(b_sinks, order, axis=1), -1)
+        pick_ipin = jnp.take_along_axis(ent_ipin, order, axis=1)
+        pick_cell = jnp.where(
+            pick_valid, jnp.take_along_axis(ent_cell, order, axis=1), 0)
+        pick_wdel = jnp.take_along_axis(ent_wdel, order, axis=1)
+
+        # --- pointer-chase traceback in cell space ---
+        ar_b = arangeB[:, None]
+        ar_g = jnp.arange(G)[None, :]
+        noc_p1 = jnp.append(pg.node_of_cell, N)
+
+        def walk_step(pos, ws):
+            cur, done, cells_w, nodes_w, wst = ws
+            nd = jnp.take(noc_p1, cur)                 # [B, G]
+            cells_w = cells_w.at[ar_b, ar_g, pos].set(
+                jnp.where(done, ncells, cur))
+            nodes_w = nodes_w.at[ar_b, ar_g, pos].set(
+                jnp.where(done, N, nd))
+            w = jnp.take_along_axis(
+                wenter, jnp.clip(cur, 0, ncells - 1), axis=1)
+            wst = wst.at[ar_b, ar_g, pos].set(jnp.where(done, 0.0, w))
+            nxt = jnp.take_along_axis(
+                pred, jnp.clip(cur, 0, ncells - 1), axis=1)
+            stop = done | (nxt == cur)
+            return jnp.where(stop, cur, nxt), stop, cells_w, nodes_w, wst
+
+        cells_w0 = jnp.full((B, G, Kw), ncells, jnp.int32)
+        nodes_w0 = jnp.full((B, G, Kw), N, jnp.int32)
+        wst0 = jnp.zeros((B, G, Kw), jnp.float32)
+        cur, done, cells_w, nodes_w, wst = lax.fori_loop(
+            0, Kw, walk_step,
+            (pick_cell, ~pick_valid, cells_w0, nodes_w0, wst0))
+        # a walk is complete iff it reached a pred==self cell in budget
+        nxt_last = jnp.take_along_axis(
+            pred, jnp.clip(cur, 0, ncells - 1), axis=1)
+        okw = pick_valid & (nxt_last == cur)
+        ok = okw                                              # [B, G]
+
+        join = jnp.clip(cur, 0, ncells - 1)
+        at_entry = jnp.take_along_axis(entry_flag, join, axis=1) & ok
+        tdel_base = jnp.where(
+            at_entry, 0.0,
+            jnp.take_along_axis(tdel_cells, join, axis=1))     # [B, G]
+        wsum = jnp.flip(jnp.cumsum(jnp.flip(wst, 2), axis=2), 2)
+        d_new = tdel_base + wsum[:, :, 0] + pick_wdel          # at sink
+
+        # entry suffix: which OPIN fed the winning entry cell
+        wk_join = jnp.take_along_axis(wk, join, axis=1)        # [B, G]
+        eoidx_p1 = jnp.concatenate(
+            [b_eoidx, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        oidx_join = jnp.take_along_axis(eoidx_p1,
+                                        jnp.minimum(wk_join, Ko), axis=1)
+        opin_join = jnp.take_along_axis(b_opin, oidx_join, axis=1)
+
+        # --- assemble path rows: [sink, ipin, nodes..., (opin, source)] ---
+        dup = jnp.concatenate(
+            [jnp.zeros((B, G, 1), bool),
+             nodes_w[:, :, 1:] == nodes_w[:, :, :-1]], axis=2)
+        keep = ~dup & (nodes_w < N) & ok[:, :, None]
+        posn = jnp.cumsum(keep, axis=2) - 1
+        seg = jnp.full((B, G, max_len), N, jnp.int32)
+        seg = seg.at[:, :, 0].set(jnp.where(ok, pick_sink, N))
+        seg = seg.at[:, :, 1].set(jnp.where(ok, pick_ipin, N))
+        seg = seg.at[ar_b[:, :, None], ar_g[:, :, None],
+                     jnp.where(keep, posn + 2, max_len)].set(
+            nodes_w, mode="drop")
+        nkeep = jnp.sum(keep, axis=2)                          # [B, G]
+        put_e = at_entry & ok
+        seg = seg.at[ar_b, ar_g,
+                     jnp.where(put_e, nkeep + 2, max_len)].set(
+            opin_join, mode="drop")
+        seg = seg.at[ar_b, ar_g,
+                     jnp.where(put_e, nkeep + 3, max_len)].set(
+            jnp.broadcast_to(b_src[:, None], (B, G)), mode="drop")
+
+        # --- store results at the picked sink slots ---
+        old = jnp.take_along_axis(wpaths, order[:, :, None], axis=1)
+        wpaths = wpaths.at[ar_b, order].set(
+            jnp.where(ok[:, :, None], seg, old))
+        old_d = jnp.take_along_axis(delay, order, axis=1)
+        delay = delay.at[ar_b, order].set(jnp.where(ok, d_new, old_d))
+        old_r = jnp.take_along_axis(reached_all, order, axis=1)
+        reached_all = reached_all.at[ar_b, order].set(ok | old_r)
+        old_rem = jnp.take_along_axis(remaining, order, axis=1)
+        remaining = remaining.at[ar_b, order].set(old_rem & ~ok)
+
+        # --- grow the tree (cell space), deterministically via min ---
+        walk_cells = jnp.where(ok[:, :, None], cells_w, ncells
+                               ).reshape(B, -1)
+        walk_tdel = (tdel_base[:, :, None] + wsum).reshape(B, -1)
+        buf = jnp.full((B, ncells + 1), INF, jnp.float32)
+        buf = buf.at[arangeB[:, None], walk_cells].min(walk_tdel)
+        newly = jnp.isfinite(buf[:, :ncells])
+        tdel_cells = jnp.where(newly, buf[:, :ncells], tdel_cells)
+        seed_cells = seed_cells | newly
+        opin_used = opin_used.at[arangeB[:, None],
+                                 jnp.where(put_e, oidx_join, O)].set(
+            True, mode="drop") | opin_used
+        return (seed_cells, tdel_cells, opin_used, remaining, wpaths,
+                delay, reached_all)
+
+    state0 = (seed0, jnp.zeros((B, ncells), jnp.float32),
+              jnp.zeros((B, O), bool), b_sinks >= 0,
+              jnp.full((B, S, max_len), N, jnp.int32),
+              jnp.full((B, S), INF, jnp.float32),
+              jnp.zeros((B, S), bool))
+    (_, _, _, _, p, delay, reached) = lax.fori_loop(
+        0, num_waves, wave_body, state0)
+
+    usage = usage_from_paths(p, nodes_p1) & valid[:, None]
+    occ_new = occ_rip + jnp.sum(usage, axis=0, dtype=jnp.int32)
+
+    smask = b_sinks >= 0
+    ok = (reached | ~smask).all(axis=1)
+    new_bb = jnp.where(ok[:, None], b_bb, full_bb[None, :])
+
+    sel_v = jnp.where(valid, sel, R).astype(jnp.int32)
+    paths = paths.at[sel_v].set(p, mode="drop")
+    sink_delay = sink_delay.at[sel_v].set(delay, mode="drop")
+    all_reached = all_reached.at[sel_v].set(ok, mode="drop")
+    bb = bb.at[sel_v].set(new_bb, mode="drop")
+    return (paths, sink_delay, all_reached, bb, occ_new,
+            valid.sum(dtype=jnp.int32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nsweeps", "max_len", "num_waves", "group",
+                     "doubling", "mesh"),
+    donate_argnames=("occ", "paths", "sink_delay", "all_reached", "bb"))
+def route_batch_resident_planes(
+        pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
+        paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_cell_all, sink_ipin_all, sink_wdelay_all,
+        sel, valid, full_bb,
+        nsweeps: int, max_len: int, num_waves: int, group: int,
+        doubling: bool = False, mesh=None):
+    """Standalone one-batch wrapper of _step_core (resident-state
+    contract of search.route_batch_resident; the host picked the nets,
+    so force=True)."""
+    paths, sink_delay, all_reached, bb, occ, _ = _step_core(
+        pg, dev, occ, acc, pres_fac, paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_cell_all, sink_ipin_all, sink_wdelay_all,
+        sel, valid, jnp.bool_(True), full_bb,
+        nsweeps, max_len, num_waves, group, doubling, mesh)
+    return (paths, sink_delay, all_reached, bb, occ,
+            jnp.int32(nsweeps * num_waves))
+
+
+def _mis_colors(dev: DeviceRRGraph, occ, paths, all_reached,
+                topk: int, n_colors: int):
+    """Device-side conflict scheduling: greedy parallel MIS coloring of
+    the reroute set over the top-K MOST-OVERUSED nodes (the linear-work
+    replacement for the host O(I^2) greedy coloring of round 2 — the
+    reference's custom_vertex_coloring,
+    partitioning_multi_sink_delta_stepping_route.cxx:3323, re-done as
+    bitmap rounds: a net takes color c iff it holds the min net id on
+    every contested node among the still-uncolored).  Nets left after
+    n_colors-1 rounds share the last class.
+
+    Returns (rrm [R], colors [R])."""
+    N = dev.num_nodes
+    R = paths.shape[0]
+    over = jnp.maximum(occ - dev.capacity, 0)
+    over_p1 = jnp.append(over > 0, False)
+    rrm = over_p1[paths].any(axis=(1, 2)) | ~all_reached
+    val, ids = lax.top_k(over, topk)
+    ids = jnp.where(val > 0, ids, N)
+    ids_sorted = jnp.sort(ids)
+    flat = paths.reshape(R, -1)
+    pos = jnp.clip(jnp.searchsorted(ids_sorted, flat), 0, topk - 1)
+    hit = (ids_sorted[pos] == flat) & (flat < N)
+    U = jnp.zeros((R, topk + 1), bool).at[
+        jnp.arange(R)[:, None], jnp.where(hit, pos, topk)].set(
+        True)[:, :topk]
+    U = U & rrm[:, None]
+    prio = jnp.arange(R, dtype=jnp.int32)
+    color = jnp.full(R, n_colors - 1, jnp.int32)
+    uncol = rrm
+    for c in range(n_colors - 1):
+        Uc = U & uncol[:, None]
+        claim = jnp.min(jnp.where(Uc, prio[:, None], R), axis=0)
+        conflict = (Uc & (claim[None, :] != prio[:, None])).any(axis=1)
+        joins = uncol & ~conflict
+        color = jnp.where(joins, c, color)
+        uncol = uncol & ~joins
+    return rrm, color
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("K_iters", "nsweeps", "max_len", "num_waves",
+                     "group", "doubling", "topk", "n_colors", "mesh"),
+    donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
+                     "bb"))
+def route_window_planes(
+        pg: PlanesGraph, dev: DeviceRRGraph, occ, acc,
+        paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_cell_all, sink_ipin_all, sink_wdelay_all,
+        sel_plan, valid_plan, full_bb,
+        pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+        K_iters: int, nsweeps: int, max_len: int, num_waves: int,
+        group: int, doubling: bool = True, topk: int = 1024,
+        n_colors: int = 5, mesh=None):
+    """A WINDOW of K_iters complete PathFinder iterations as ONE device
+    program: per iteration, every batch group in sel_plan [G, B] runs the
+    fused rip-up/route/commit step (clean nets no-op via the device-side
+    reroute predicate), then the PathFinder present/history update
+    (congestion.h:177-193).  One host round trip per window instead of
+    per batch — on the tunneled single-chip TPU a device<->host sync
+    costs ~65-70 ms, which dominated every earlier design; the host
+    fetches only this program's summary, decides convergence/widening,
+    re-plans the groups from the device-computed coloring, and dispatches
+    the next window.
+
+    Returns (occ, acc, paths, sink_delay, all_reached, bb, pres,
+    rrm [R], colors [R], n_over, over_total)."""
+    G = sel_plan.shape[0]
+
+    def it_body(it, st):
+        occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes = st
+        force = (it0 + it) < force_until
+
+        def g_step(g, st2):
+            occ2, paths2, sink_delay2, all_reached2, bb2, nr = st2
+            (paths2, sink_delay2, all_reached2, bb2, occ2,
+             n_act) = _step_core(
+                pg, dev, occ2, acc, pres,
+                paths2, sink_delay2, all_reached2, bb2,
+                source_all, sinks_all, crit_all,
+                opin_node_all, entry_cell_all, entry_oidx_all,
+                entry_delay_all,
+                sink_cell_all, sink_ipin_all, sink_wdelay_all,
+                sel_plan[g], valid_plan[g], force, full_bb,
+                nsweeps, max_len, num_waves, group, doubling, mesh)
+            return (occ2, paths2, sink_delay2, all_reached2, bb2,
+                    nr + n_act)
+
+        occ, paths, sink_delay, all_reached, bb, nroutes = lax.fori_loop(
+            0, G, g_step,
+            (occ, paths, sink_delay, all_reached, bb, nroutes))
+        # PathFinder history/present escalation once per iteration
+        acc = acc + acc_fac * jnp.maximum(
+            occ - dev.capacity, 0).astype(jnp.float32)
+        pres = jnp.minimum(max_pres, pres * pres_mult)
+        return occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes
+
+    (occ, acc, paths, sink_delay, all_reached, bb, pres,
+     nroutes) = lax.fori_loop(
+        0, K_iters, it_body,
+        (occ, acc, paths, sink_delay, all_reached, bb, pres0,
+         jnp.int32(0)))
+
+    rrm, colors = _mis_colors(dev, occ, paths, all_reached,
+                              topk, n_colors)
+    over = jnp.maximum(occ - dev.capacity, 0)
+    return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
+            colors, (over > 0).sum(dtype=jnp.int32),
+            over.sum(dtype=jnp.int32), nroutes)

@@ -450,6 +450,21 @@ def overuse_summary(dev: DeviceRRGraph, occ):
     return (over > 0).sum(dtype=jnp.int32), over.sum(dtype=jnp.int32)
 
 
+@jax.jit
+def iteration_summary(dev: DeviceRRGraph, occ, paths, all_reached,
+                      steps_total):
+    """Everything the host loop needs per iteration, in ONE fetch: the
+    next iteration's reroute mask, reached flags, overuse summary, and
+    the accumulated relax-step counter (the per-batch counters stay lazy
+    device scalars — through the ~ms-latency tunnel every separate
+    device->host read costs a round trip)."""
+    over = jnp.maximum(0, occ - dev.capacity)
+    over_p1 = jnp.append(occ > dev.capacity, False)
+    rrm = over_p1[paths].any(axis=(1, 2)) | ~all_reached
+    return (rrm, all_reached, (over > 0).sum(dtype=jnp.int32),
+            over.sum(dtype=jnp.int32), steps_total)
+
+
 @functools.partial(jax.jit, static_argnames=("K",))
 def conflict_subset(dev: DeviceRRGraph, occ, paths, idx_pad, K: int):
     """Conflict matrix among a padded subset of nets: C[i, j] = nets
@@ -462,7 +477,11 @@ def conflict_subset(dev: DeviceRRGraph, occ, paths, idx_pad, K: int):
     partitioning_multi_sink_delta_stepping_route.cxx:3563)."""
     N = dev.num_nodes
     I = idx_pad.shape[0]
-    over_ids = jnp.nonzero(occ > dev.capacity, size=K, fill_value=N + 1)[0]
+    # the K MOST-OVERUSED nodes (not the K lowest ids): when overuse
+    # exceeds K, the worst contention stays visible to the coloring
+    over_amt = jnp.maximum(occ - dev.capacity, 0)
+    val, ids = jax.lax.top_k(over_amt, K)
+    over_ids = jnp.sort(jnp.where(val > 0, ids, N + 1))
     p = paths[jnp.clip(idx_pad, 0)].reshape(I, -1)
     pos = jnp.searchsorted(over_ids, p).astype(jnp.int32)
     posc = jnp.clip(pos, 0, K - 1)
@@ -745,13 +764,24 @@ def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
         sc = jnp.clip(sink_loc, 0, Nbox - 1)
         sx = jnp.take_along_axis(xl, sc, axis=1)
         sy = jnp.take_along_axis(yl, sc, axis=1)
-        dx = jnp.maximum(jnp.maximum(xl[:, :, None] - sx[:, None, :],
-                                     sx[:, None, :] - xh[:, :, None]), 0)
-        dy = jnp.maximum(jnp.maximum(yl[:, :, None] - sy[:, None, :],
-                                     sy[:, None, :] - yh[:, :, None]), 0)
-        man = dx + dy                                       # [B, Nbox, S]
-        man = jnp.min(jnp.where(remaining[:, None, :], man, 1 << 28),
-                      axis=2).astype(jnp.float32)
+        # per sink-chunk so the [B, Nbox, chunk] transient stays O(B*Nbox)
+        # instead of a multi-GB [B, Nbox, S] blow-up at Titan-class Nbox
+        S_all = sink_loc.shape[1]
+        CH = min(8, S_all)
+        man = jnp.full((B, Nbox), 1 << 28, jnp.int32)
+        for s0 in range(0, S_all, CH):
+            sxc = sx[:, s0:s0 + CH]
+            syc = sy[:, s0:s0 + CH]
+            remc = remaining[:, s0:s0 + CH]
+            dx = jnp.maximum(jnp.maximum(
+                xl[:, :, None] - sxc[:, None, :],
+                sxc[:, None, :] - xh[:, :, None]), 0)
+            dy = jnp.maximum(jnp.maximum(
+                yl[:, :, None] - syc[:, None, :],
+                syc[:, None, :] - yh[:, :, None]), 0)
+            man = jnp.minimum(man, jnp.min(
+                jnp.where(remc[:, None, :], dx + dy, 1 << 28), axis=2))
+        man = man.astype(jnp.float32)
         lb = man * ((1.0 - crit_w)[:, None] * lb_scale[0]
                     + crit_w[:, None] * lb_scale[1])
         dist, prev, tdel, steps = _relax_local(

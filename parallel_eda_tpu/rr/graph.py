@@ -72,6 +72,10 @@ class RRGraph:
     chan_width: int
     switch_Tdel: np.ndarray     # f32 [num_switches+1] (last = delayless)
     switch_R: np.ndarray        # f32 [num_switches+1]
+    # per-track segment / wire-to-wire switch (planes kernel co-design:
+    # route/planes.py derives its static delay planes from these)
+    seg_of_track: Optional[np.ndarray] = None       # int32 [W]
+    wire_switch_of_track: Optional[np.ndarray] = None  # int32 [W]
 
     @property
     def num_nodes(self) -> int:
@@ -414,6 +418,10 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
         src_of=src_of, sink_of=sink_of, opin_of=opin_of, ipin_of=ipin_of,
         grid=grid, chan_width=W,
         switch_Tdel=switch_Tdel, switch_R=switch_R,
+        seg_of_track=seg_of_track.astype(np.int32),
+        wire_switch_of_track=np.array(
+            [arch.segments[s].wire_switch for s in seg_of_track],
+            dtype=np.int32),
     )
 
 

@@ -62,6 +62,14 @@ def _tokens(text: str) -> List[List[str]]:
     return cmds
 
 
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
 def parse_sdc(text: str) -> SdcConstraints:
     sdc = SdcConstraints()
     for toks in _tokens(text):
@@ -78,8 +86,19 @@ def parse_sdc(text: str) -> SdcConstraints:
                 elif toks[i] == "-name":
                     cname = toks[i + 1]
                     i += 2
+                elif toks[i] in ("-add",):
+                    i += 1          # known valueless flag
+                elif toks[i] == "-waveform":
+                    # consume the numeric edge list (braces were dropped
+                    # by the tokenizer, so take all following numbers)
+                    i += 1
+                    while i < len(toks) and _is_number(toks[i]):
+                        i += 1
                 elif toks[i].startswith("-"):
-                    i += 2          # unknown option + value
+                    # guessing an unknown option's arity can swallow a
+                    # port name and silently mis-assign the clock
+                    raise ValueError(
+                        f"create_clock: unknown option {toks[i]}")
                 else:
                     ports.append(toks[i])
                     i += 1

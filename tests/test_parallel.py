@@ -123,9 +123,10 @@ def test_windowed_sharded_matches_single_device():
     from tests.test_router import _big_grid_flow
 
     rr, term = _big_grid_flow(seed=13)
-    res0 = Router(rr, RouterOpts(batch_size=16)).route(term)
+    opts = dict(batch_size=16, program="ell", sink_group=1, windowed=True)
+    res0 = Router(rr, RouterOpts(**opts)).route(term)
     mesh = make_mesh(8, shape=(4, 2))
-    res1 = Router(rr, RouterOpts(batch_size=16), mesh=mesh).route(term)
+    res1 = Router(rr, RouterOpts(**opts), mesh=mesh).route(term)
     assert res0.success and res1.success
     assert res0.windowed_nets > 0 and \
         res0.windowed_nets == res1.windowed_nets

@@ -1,0 +1,134 @@
+"""Planes-kernel tests: the structured scan/shift relaxation
+(route/planes.py) must be exactly equivalent to the gather-based ELL
+relaxation (route/search.py _relax) — the two independent implementations
+of the same cost model are each other's oracle — and the planes router
+must produce legal, deterministic routings."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import minimal_arch
+from parallel_eda_tpu.arch.model import SegmentInf
+from parallel_eda_tpu.flow import synth_flow
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
+from parallel_eda_tpu.route.device_graph import to_device
+from parallel_eda_tpu.route.planes import build_planes, planes_relax
+from parallel_eda_tpu.route.search import _relax
+from parallel_eda_tpu.rr.graph import CHANX, CHANY, build_rr_graph
+from parallel_eda_tpu.rr.grid import DeviceGrid
+
+
+def _mixed_len_arch():
+    arch = minimal_arch(chan_width=12)
+    arch.segments = [
+        SegmentInf(name="l1", length=1, frequency=0.4, wire_switch=0,
+                   opin_switch=1),
+        SegmentInf(name="l2", length=2, frequency=0.3, Rmetal=80.0,
+                   Cmetal=15e-15, wire_switch=1, opin_switch=1),
+        SegmentInf(name="l4", length=4, frequency=0.3, Rmetal=60.0,
+                   Cmetal=12e-15, wire_switch=0, opin_switch=0),
+    ]
+    return arch
+
+
+@pytest.mark.parametrize("arch,nx,ny,seed", [
+    (minimal_arch(chan_width=6), 4, 4, 0),
+    (_mixed_len_arch(), 7, 7, 7),
+    (_mixed_len_arch(), 5, 9, 11),
+])
+def test_planes_relax_matches_ell(arch, nx, ny, seed):
+    """Wire-node distances from the planes relaxation equal the ELL
+    pull-relaxation on random seeds/congestion/criticality/bounding
+    boxes, including mixed-length staggered segments and rectangular
+    grids."""
+    grid = DeviceGrid(nx, ny, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    dev = to_device(rr)
+    pg = build_planes(rr)
+    N = rr.num_nodes
+    B = 4
+    rng = np.random.default_rng(seed)
+    wires = np.where((rr.node_type == CHANX) | (rr.node_type == CHANY))[0]
+    seed_m = np.zeros((B, N), bool)
+    for b in range(B):
+        seed_m[b, rng.choice(wires, 2, replace=False)] = True
+    cong = rng.uniform(0.5, 2.0, (B, N)).astype(np.float32) * 1e-10
+    crit = rng.uniform(0.0, 0.9, (B, 1)).astype(np.float32)
+    crit[0] = 0.0
+    inside = np.ones((B, N), bool)
+    inside[1] = ((rr.xhigh >= 1) & (rr.xlow <= max(2, nx // 2))
+                 & (rr.yhigh >= 1) & (rr.ylow <= ny))
+    cong_m = np.where(inside, (1 - crit) * cong, np.inf).astype(np.float32)
+
+    dist, _, _, _ = _relax(
+        dev, jnp.asarray(cong_m), jnp.asarray(crit), jnp.asarray(inside),
+        jnp.asarray(seed_m), jnp.zeros((B, N), jnp.float32), 500)
+    dist = np.asarray(dist)
+
+    noc = np.asarray(pg.node_of_cell)
+    d0 = np.where(seed_m[:, noc], 0.0, np.inf).astype(np.float32)
+    dist_flat, pred, wenter = planes_relax(
+        pg, jnp.asarray(d0), jnp.asarray(cong_m[:, noc]),
+        jnp.asarray(crit)[:, :, None, None],
+        jnp.zeros((B, pg.ncells), jnp.float32), 64)
+    dist_flat = np.asarray(dist_flat)
+    con = np.asarray(pg.cell_of_node)
+    distp = np.full((B, N), np.inf, np.float32)
+    wmask = con < pg.ncells
+    distp[:, wmask] = dist_flat[:, con[wmask]]
+
+    a, b = dist[:, wires], distp[:, wires]
+    both_inf = np.isinf(a) & np.isinf(b)
+    assert (np.isclose(a, b, rtol=1e-4, atol=1e-13) | both_inf).all()
+
+    # pred chains must terminate at a seed and strictly descend
+    pred = np.asarray(pred)
+    for bi in range(B):
+        fin = np.where(np.isfinite(dist_flat[bi]))[0]
+        for c in fin[:: max(1, len(fin) // 17)]:
+            cur, steps = int(c), 0
+            while int(pred[bi][cur]) != cur and steps < 10000:
+                nxt = int(pred[bi][cur])
+                assert dist_flat[bi][nxt] <= dist_flat[bi][cur] + 1e-12
+                cur, steps = nxt, steps + 1
+            assert int(pred[bi][cur]) == cur
+            assert d0[bi][cur] == 0.0, "walk must end at a seed"
+
+
+def test_planes_route_legal_and_deterministic():
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=3)
+    r1 = Router(f.rr, RouterOpts(batch_size=64)).route(f.term)
+    assert r1.success
+    check_route(f.rr, f.term, r1.paths, occ=r1.occ)
+    r2 = Router(f.rr, RouterOpts(batch_size=64)).route(f.term)
+    assert np.array_equal(r1.paths, r2.paths)
+    assert np.array_equal(r1.occ, r2.occ)
+
+
+def test_planes_vs_ell_quality():
+    """The two programs implement the same cost model; their negotiated
+    wirelengths must land in the same quality class (not bit-equal: the
+    search orders differ, so tie-breaks and trajectories differ)."""
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=3)
+    rp = Router(f.rr, RouterOpts(batch_size=64, sink_group=1)).route(f.term)
+    re = Router(f.rr, RouterOpts(batch_size=64, sink_group=1,
+                                 program="ell")).route(f.term)
+    assert rp.success and re.success
+    check_route(f.rr, f.term, rp.paths, occ=rp.occ)
+    assert rp.wirelength <= re.wirelength * 1.15 + 5
+
+
+def test_planes_incremental_sink_schedule():
+    """sink_group=1 (exact VPR incremental) must also route legally via
+    the planes program, with wirelength no worse than the default
+    doubling schedule."""
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=3)
+    rd = Router(f.rr, RouterOpts(batch_size=64)).route(f.term)
+    r1 = Router(f.rr, RouterOpts(batch_size=64, sink_group=1)).route(f.term)
+    assert rd.success and r1.success
+    check_route(f.rr, f.term, r1.paths, occ=r1.occ)
+    assert r1.wirelength <= rd.wirelength * 1.05 + 5

@@ -1,0 +1,26 @@
+"""Crit-path-delay parity: the acceptance bar is <= 1% delay degradation
+vs the serial oracle (BASELINE.md; get_critical_path_delay semantics,
+reference vpr/SRC/timing/path_delay.c:3791).  A mult-class circuit runs
+the full timing-driven flow on both routers."""
+
+import numpy as np
+
+from parallel_eda_tpu.flow import prepare, run_place
+from parallel_eda_tpu.netlist.synthesis import array_multiplier
+from parallel_eda_tpu.route.qor import qor_compare
+from parallel_eda_tpu.arch.builtin import minimal_arch
+
+
+def test_crit_path_parity_mult6():
+    nl = array_multiplier(6)
+    f = prepare(nl, minimal_arch(chan_width=14), chan_width=14, seed=7)
+    f = run_place(f)
+    row = qor_compare(f, "mult6")
+    assert np.isfinite(row.device_cpd) and np.isfinite(row.serial_cpd)
+    # the BASELINE bar: <= 1% crit-path degradation.  (Negative = device
+    # BEAT the serial oracle's delay.)
+    assert row.cpd_delta_pct <= 1.0, (
+        f"crit path {row.device_cpd:.3e} vs serial {row.serial_cpd:.3e} "
+        f"(+{row.cpd_delta_pct:.2f}%)")
+    # wirelength stays in the same quality class
+    assert row.wl_delta_pct <= 15.0
