@@ -1,0 +1,37 @@
+import os, sys, time
+if "--tpu" not in sys.argv:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from parallel_eda_tpu.flow import synth_flow
+from parallel_eda_tpu.place import PlacerOpts
+from parallel_eda_tpu.place.sa import Placer, sa_segment
+from parallel_eda_tpu.place.initial import initial_placement
+
+f = synth_flow(num_luts=950, num_inputs=32, num_outputs=32,
+               chan_width=16, seed=5)
+NB = f.pnl.num_blocks
+placer = Placer(f.pnl, f.grid, PlacerOpts(moves_per_step=1024))
+pp = placer.pp
+pos, ring, occ = placer._state_from_pos(f.pos)
+crit = jnp.zeros(pp.net_blk.shape, jnp.float32)
+M, steps, ntemps = 1024, 32, 8
+key = jax.random.PRNGKey(0)
+out = sa_segment(pp, pos, ring, occ, crit, jnp.float32(0.0), key,
+                 jnp.float32(1e-3), jnp.float32(8.0), jnp.float32(0.0),
+                 M, steps, ntemps, False)
+np.asarray(out[0][:2])
+t0 = time.perf_counter()
+out = sa_segment(pp, out[0], out[1], out[2], crit, jnp.float32(0.0),
+                 key, jnp.float32(1e-3), jnp.float32(8.0),
+                 jnp.float32(0.0), M, steps, ntemps, False)
+np.asarray(out[0][:2])
+dt = time.perf_counter() - t0
+props = M * steps * ntemps
+print(f"platform={jax.devices()[0].platform} NB={NB} "
+      f"proposals={props} wall={dt:.3f}s "
+      f"-> {props/dt/1e6:.2f} M proposals/s")

@@ -1,0 +1,44 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+
+from parallel_eda_tpu.arch.builtin import minimal_arch
+from parallel_eda_tpu.flow import prepare, run_place, synth_flow
+from parallel_eda_tpu.netlist.synthesis import (array_multiplier,
+                                                crc_xor_tree)
+from parallel_eda_tpu.route.qor import qor_compare
+
+
+def row(name, f):
+    r = qor_compare(f, name)
+    print(f"| {name} | {r.device_cpd*1e9:.3f} | {r.serial_cpd*1e9:.3f} | "
+          f"{r.cpd_delta_pct:+.2f}% | {r.device_wl} | {r.serial_wl} | "
+          f"{r.wl_delta_pct:+.1f}% | {r.device_iters} | {r.serial_iters} |",
+          flush=True)
+
+
+print("| circuit | device CPD (ns) | serial CPD (ns) | dCPD | "
+      "device wl | serial wl | dWL | dev iters | serial iters |")
+print("|---|---|---|---|---|---|---|---|---|")
+
+f = synth_flow(num_luts=60, num_inputs=12, num_outputs=12, chan_width=12,
+               seed=11)
+f = run_place(f)
+row("synth60 W12", f)
+
+nl = array_multiplier(6)
+f = prepare(nl, minimal_arch(chan_width=14), chan_width=14, seed=7)
+f = run_place(f)
+row("mult6 W14", f)
+
+nl = array_multiplier(10)
+f = prepare(nl, minimal_arch(chan_width=16), chan_width=16, seed=7)
+f = run_place(f)
+row("mult10 W16", f)
+
+nl = crc_xor_tree(width=16, data_bits=16, K=4)
+f = prepare(nl, minimal_arch(chan_width=16), chan_width=16, seed=9)
+f = run_place(f)
+row("crc16 W16", f)

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the tunneled TPU every 4 minutes; when it answers, run the real
+# bench (which also prewarms the persistent compile cache) and exit.
+cd /root/repo
+for i in $(seq 1 60); do
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) tunnel back after $i probes" >> /tmp/tpu_watchdog.log
+    python bench.py --luts 60 --chan_width 12 --batch 64 > /tmp/bench_tpu_final.log 2>&1
+    echo "$(date +%H:%M:%S) bench rc=$?" >> /tmp/tpu_watchdog.log
+    tail -1 /tmp/bench_tpu_final.log >> /tmp/tpu_watchdog.log
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) probe $i: down" >> /tmp/tpu_watchdog.log
+  sleep 240
+done

@@ -42,27 +42,41 @@ def _enable_compile_cache() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
-def init_backend(retries: int = 4, delay_s: float = 10.0) -> str:
+def init_backend(retries: int = 3, delay_s: float = 20.0,
+                 probe_timeout_s: float = 180.0) -> str:
     """Initialize the JAX backend defensively.
 
     The tunneled single-chip TPU backend ("axon") can be transiently
-    UNAVAILABLE (chip held by another process, tunnel not up).  Retry with
-    backoff; if it never comes up, fall back to CPU so the bench still
+    UNAVAILABLE — and worse, a wedged tunnel makes jax.devices() HANG
+    forever rather than raise.  Probe it in a SUBPROCESS with a hard
+    timeout; only once a probe succeeds does this process touch the
+    backend.  If it never comes up, fall back to CPU so the bench still
     emits its JSON line (detail.platform records what actually ran)."""
-    import jax
+    import subprocess
 
-    last = None
+    last = "unknown"
     for attempt in range(retries):
         try:
-            devs = jax.devices()
-            return devs[0].platform
-        except Exception as e:  # backend init failure is a RuntimeError
-            last = e
-            log(f"backend init failed (attempt {attempt + 1}/{retries}): "
-                f"{e}")
-            time.sleep(delay_s * (attempt + 1))
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=probe_timeout_s)
+            if r.returncode == 0 and r.stdout.strip():
+                import jax
+
+                devs = jax.devices()
+                return devs[0].platform
+            last = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
+            last = last[0][:200]
+        except subprocess.TimeoutExpired:
+            last = f"probe hung > {probe_timeout_s:.0f}s (wedged tunnel)"
+        log(f"backend probe failed (attempt {attempt + 1}/{retries}): "
+            f"{last}")
+        time.sleep(delay_s * (attempt + 1))
     log(f"falling back to CPU after {retries} failures: {last}")
     os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
     jax.config.update("jax_platforms", "cpu")
     return jax.devices()[0].platform
 

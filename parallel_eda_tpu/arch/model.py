@@ -155,6 +155,13 @@ class Arch:
     ipin_switch: int = 0
     # routing channel default width (overridden by --route_chan_width)
     default_chan_width: int = 24
+    # intra-cluster crossbar population: 1.0 = full crossbar (every
+    # cluster input/feedback reaches every BLE input pin — packing is
+    # trivially routable and the packer skips the check); < 1.0 = sparse
+    # crossbar with that fraction of the switch points populated on a
+    # deterministic staggered pattern, and the packer must verify each
+    # cluster is intra-routable (pack/cluster_legality.c semantics)
+    xbar_density: float = 1.0
 
     def block_type(self, name: str) -> BlockType:
         for t in self.block_types:

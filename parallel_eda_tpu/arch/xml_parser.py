@@ -165,12 +165,27 @@ def read_arch_xml(path: str) -> Arch:
     # --- cluster timing (delay_constant / T_setup / T_clk_to_Q under the
     # cluster pb tree, ProcessPb_Type timing annotations) ---
     def _pb_timing(pb, defaults=(400e-12, 60e-12, 80e-12)):
+        """Collapse the pb tree's timing annotations to the flat
+        (T_comb, T_setup, T_clk_to_q) stand-in: the input->output
+        combinational path is approximated as the worst interconnect
+        delay_constant (crossbar stage) PLUS the worst primitive
+        delay_matrix entry (LUT stage) — the two stage classes VPR7
+        archs annotate (ProcessPb_Type/ProcessInterconnect timing)."""
         t_comb, t_setup, t_cq = defaults
         if pb is None:
             return t_comb, t_setup, t_cq
         dels = [_f(e.attrib, "max", 0.0) for e in pb.iter("delay_constant")]
-        if dels and max(dels) > 0:
-            t_comb = max(dels)
+        mats = []
+        for e in pb.iter("delay_matrix"):
+            for tok in (e.text or "").split():
+                try:
+                    mats.append(float(tok))
+                except ValueError:
+                    pass
+        stage_ic = max(dels) if dels else 0.0
+        stage_prim = max(mats) if mats else 0.0
+        if stage_ic + stage_prim > 0:
+            t_comb = stage_ic + stage_prim
         for e in pb.iter("T_setup"):
             t_setup = _f(e.attrib, "value", t_setup)
         for e in pb.iter("T_clk_to_Q"):

@@ -127,7 +127,10 @@ def run_place(flow: FlowResult,
         timing = PlacerTiming(flow.pnl, lookup, flow.term, flow.tg,
                               td_place_exp=opts.td_place_exp)
     t0 = time.time()
-    placer = Placer(flow.pnl, flow.grid, opts, timing=timing)
+    from .place.macros import form_macros
+    macros = form_macros(flow.nl, flow.pnl) if flow.nl is not None else []
+    placer = Placer(flow.pnl, flow.grid, opts, timing=timing,
+                    macros=macros)
     flow.pos, flow.place_stats = placer.place(flow.pos)
     flow.times["place"] = time.time() - t0
     flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,

@@ -43,6 +43,11 @@ class LogicalNetlist:
     net_driver: Dict[str, int] = field(default_factory=dict)
     net_sinks: Dict[str, List[int]] = field(default_factory=dict)
     clocks: List[str] = field(default_factory=list)
+    # carry chains: ordered lists of primitive NAMES forming arithmetic
+    # carry structure (synthesis records them; the BLIF reader could
+    # derive them from .subckt carry models).  The placer forms placement
+    # macros from these (place/macros.py; reference place_macro.c)
+    carry_chains: List[List[str]] = field(default_factory=list)
 
     def add(self, prim: Primitive) -> int:
         self.primitives.append(prim)

@@ -83,7 +83,12 @@ def array_multiplier(n: int = 16, registered: bool = True,
            for j in range(n)] for i in range(n)]
 
     # carry-save rows: row 0 seeds sums with pp[0]; each later row i adds
-    # pp[i] to the shifted previous sums
+    # pp[i] to the shifted previous sums.  Column j's adders form a carry
+    # chain down the rows; the final ripple row is one long chain — both
+    # recorded for placement macros (place/macros.py, place_macro.c
+    # semantics)
+    col_chain: List[List[str]] = [[] for _ in range(n + 1)]
+    rip_chain: List[str] = []
     sums = list(pp[0])           # weight j (for bit j of row base 0)
     carries: List[str] = []
     prod: List[str] = [sums[0]]  # p0
@@ -104,6 +109,7 @@ def array_multiplier(n: int = 16, registered: bool = True,
                 s, co = _half_adder(nl, tag, x, c)
             else:
                 s, co = _full_adder(nl, tag, x, y, c)
+            col_chain[j].append(f"{tag}_c")
             new_sums.append(s)
             new_carries.append(co)
         sums, carries = new_sums, new_carries
@@ -123,6 +129,7 @@ def array_multiplier(n: int = 16, registered: bool = True,
             s, carry = _half_adder(nl, tag, y, carry)
         else:
             s, carry = _full_adder(nl, tag, y, c, carry)
+        rip_chain.append(f"{tag}_c")
         prod.append(s)
     if carry is not None:
         prod.append(carry)
@@ -130,6 +137,9 @@ def array_multiplier(n: int = 16, registered: bool = True,
     for k, p in enumerate(prod):
         out = _ff(nl, f"rp{k}", p, clk) if registered else p
         nl.add(Primitive(name=f"out:p{k}", kind=PRIM_OUTPAD, inputs=[out]))
+    nl.carry_chains = [c for c in col_chain if len(c) >= 2]
+    if len(rip_chain) >= 2:
+        nl.carry_chains.append(rip_chain)
     nl.finalize()
     return nl
 

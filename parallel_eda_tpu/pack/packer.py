@@ -105,6 +105,80 @@ def _ble_criticalities(bles: List[_BLE], producers: Dict[str, int]):
     return [(arr[v] + req_from[v]) / dmax for v in range(nble)]
 
 
+def _xbar_allowed(p: int, j: int, k: int, density: float,
+                  I: int = 0) -> bool:
+    """Is crossbar switch point (source pin p -> BLE j input k)
+    populated?  Deterministic staggered pattern with the given density
+    (the sparse-crossbar model; a real arch would supply the pattern,
+    this mirrors the staggered-spread style of rr Fc patterns).  Every
+    (j, k) keeps one guaranteed baseline pin — real sparse crossbars
+    never strand a BLE input — so a lone BLE is always routable and
+    infeasibility is a genuine multi-signal matching conflict."""
+    if I > 0 and p == (j * 5 + k) % I:
+        return True
+    return ((p * 13 + j * 7 + k * 3) % 97) < density * 97
+
+
+def cluster_routable(bles: List[_BLE], members, clocks, arch: Arch) -> bool:
+    """Intra-cluster routability check (pack/cluster_legality.c
+    semantics — the reference detail-routes each candidate cluster
+    through the pb graph; here the cluster interconnect model is a
+    crossbar, so feasibility is a bipartite matching problem).
+
+    Under a sparse crossbar (arch.xbar_density < 1), a signal entering
+    on cluster input pin p reaches BLE input (j, k) only where the
+    switch point exists.  Internal feedbacks are pinned to dedicated
+    sources (pin I+j for BLE slot j).  Feasible iff every internal
+    signal's fixed source covers all its consumers AND the external
+    signals admit a matching onto distinct input pins that each cover
+    all of that signal's consumers.  Full crossbar returns True without
+    work (the fast path)."""
+    d = getattr(arch, "xbar_density", 1.0)
+    if d >= 1.0:
+        return True
+    I = arch.I
+    ordered = sorted(members)
+    outs = {bles[m].output: j for j, m in enumerate(ordered)}
+    sig_cons: Dict[str, List[tuple]] = {}
+    for j, m in enumerate(ordered):
+        for k, n in enumerate(bles[m].inputs):
+            if n in clocks:
+                continue
+            sig_cons.setdefault(n, []).append((j, k))
+
+    ext_pin_options: List[List[int]] = []
+    for s, cons in sig_cons.items():
+        if s in outs:
+            p = I + outs[s]
+            if not all(_xbar_allowed(p, j, k, d) for (j, k) in cons):
+                return False
+        else:
+            opts = [p for p in range(I)
+                    if all(_xbar_allowed(p, j, k, d, I)
+                           for (j, k) in cons)]
+            if not opts:
+                return False
+            ext_pin_options.append(opts)
+
+    # Kuhn's augmenting-path matching: external signals -> distinct pins
+    pin_of: Dict[int, int] = {}
+
+    def try_assign(si: int, seen) -> bool:
+        for p in ext_pin_options[si]:
+            if p in seen:
+                continue
+            seen.add(p)
+            if p not in pin_of or try_assign(pin_of[p], seen):
+                pin_of[p] = si
+                return True
+        return False
+
+    for si in range(len(ext_pin_options)):
+        if not try_assign(si, set()):
+            return False
+    return True
+
+
 def pack_netlist(nl: LogicalNetlist, arch: Arch,
                  timing_driven: bool = True,
                  alpha: float = 0.75) -> PackedNetlist:
@@ -170,6 +244,14 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
         # get_seed_logical_molecule_with_most_critical_inputs), degree as
         # the tiebreak (and the whole criterion when not timing-driven)
         seed = max(unclustered, key=lambda b: (crit[b], degree[b], -b))
+        if not cluster_routable(bles, {seed}, clocks, arch):
+            # a lone BLE that cannot route through the cluster crossbar
+            # means the netlist does not fit this arch at all — error
+            # out like the reference's cluster_legality failure path
+            raise ValueError(
+                f"BLE {seed} is not routable through the sparse "
+                f"crossbar (xbar_density="
+                f"{getattr(arch, 'xbar_density', 1.0)}) even alone")
         members: Set[int] = {seed}
         unclustered.remove(seed)
         clk = bles[seed].clock
@@ -192,6 +274,9 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
                     continue
                 if cluster_inputs(members, c) > I:
                     continue
+                if not cluster_routable(bles, members | {c}, clocks,
+                                        arch):
+                    continue
                 s = attraction(members, c)
                 if s > best_score:
                     best, best_score = c, s
@@ -202,7 +287,9 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
                     bc = bles[c]
                     if bc.clock is not None and clk is not None and bc.clock != clk:
                         continue
-                    if cluster_inputs(members, c) <= I:
+                    if (cluster_inputs(members, c) <= I
+                            and cluster_routable(bles, members | {c},
+                                                 clocks, arch)):
                         best = c
                         break
             if best is None:

@@ -1,4 +1,5 @@
 from .initial import initial_placement
+from .macros import align_initial, form_macros
 from .sa import (Placer, PlacerOpts, PlacerTiming, PlaceStats,
                  build_place_problem, net_bb_cost, net_td_cost)
 from .delay_lookup import DelayLookup, compute_delay_lookup

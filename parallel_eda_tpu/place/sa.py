@@ -97,6 +97,10 @@ class PlaceProblem:
     # timing model: delta-delay matrices (delay_lookup) padded to one
     # [4, nx+2, ny+2] stack ordered (clb_clb, io_clb, clb_io, io_io)
     delta: jnp.ndarray         # f32 [4, nx+2, ny+2]
+    # placement macros (carry chains, place_macro.c): members are frozen
+    # out of single-block moves and moved rigidly by macro_step
+    movable: jnp.ndarray       # int32 [NBm] blocks eligible for singles
+    frozen: jnp.ndarray        # bool [NB] macro members
     # static geometry (python ints; hashable side data)
     nx: int = struct.field(pytree_node=False)
     ny: int = struct.field(pytree_node=False)
@@ -138,10 +142,12 @@ class PlaceStats:
 
 
 def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid,
-                        lookup=None) -> PlaceProblem:
+                        lookup=None, macros=None) -> PlaceProblem:
     """Extract the ELL tables the device step needs.  ``lookup`` is an
     optional place.delay_lookup.DelayLookup for timing-driven placement
-    (zeros otherwise -> td cost identically 0)."""
+    (zeros otherwise -> td cost identically 0).  ``macros``: block-id
+    chains (place/macros.py) whose members are frozen out of
+    single-block moves."""
     NB = pnl.num_blocks
     costed = [i for i, n in enumerate(pnl.nets)
               if not n.is_global and n.sinks]
@@ -214,6 +220,12 @@ def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid,
     else:
         delta = np.zeros((4, H, W), dtype=np.float32)
 
+    frozen = np.zeros(NB, dtype=bool)
+    for m in (macros or []):
+        frozen[list(m)] = True
+    movable = np.where(~frozen)[0].astype(np.int32)
+    if len(movable) == 0:
+        movable = np.zeros(1, dtype=np.int32)
     return PlaceProblem(
         net_blk=jnp.asarray(net_blk), net_valid=jnp.asarray(net_valid),
         net_q=jnp.asarray(net_q), blk_net=jnp.asarray(blk_net),
@@ -221,6 +233,7 @@ def build_place_problem(pnl: PackedNetlist, grid: DeviceGrid,
         type_id=jnp.asarray(type_id), col_list=jnp.asarray(col_list),
         ncols=jnp.asarray(ncols), col_idx_of_x=jnp.asarray(col_idx_of_x),
         delta=jnp.asarray(delta),
+        movable=jnp.asarray(movable), frozen=jnp.asarray(frozen),
         nx=grid.nx, ny=grid.ny, io_cap=grid.io_capacity,
     )
 
@@ -286,7 +299,8 @@ def _propose(pp: PlaceProblem, pos, ring_idx, key, rlim, M: int):
     NB = pp.num_blocks
     NRING = pp.ring_xy.shape[0]
     k1, k2, k2b, k3, k4 = jax.random.split(key, 5)
-    b = jax.random.randint(k1, (M,), 0, NB)
+    # draw from the movable set only (macro members move via macro_step)
+    b = pp.movable[jax.random.randint(k1, (M,), 0, pp.movable.shape[0])]
     bio = pp.is_io[b]
     rl = jnp.maximum(1, rlim.astype(jnp.int32))
 
@@ -347,7 +361,9 @@ def sa_step(pp: PlaceProblem, pos, ring_idx, occ, crit, inv_bb, inv_td,
     claim = claim.at[src].min(jnp.arange(M, dtype=jnp.int32))
     claim = claim.at[dst].min(jnp.arange(M, dtype=jnp.int32))
     own = ((claim[src] == jnp.arange(M)) & (claim[dst] == jnp.arange(M))
-           & ~self_move)
+           & ~self_move
+           # a single-block swap must not displace a macro member
+           & ~(pp.frozen[jnp.clip(occ_d, 0)] & (occ_d >= 0)))
 
     # ---- delta cost of each move (exact under `own` independence) ----
     o = occ_d                                          # [M] may be -1
@@ -520,6 +536,153 @@ def sa_segment(pp: PlaceProblem, pos, ring_idx, occ, crit, tradeoff,
     return pos, ring_idx, occ, t, rlim, na, nv, bb, live, ts, rls
 
 
+def _macro_delta_bb(pp: PlaceProblem, pos, blocks, occs, newpos, memv):
+    """bb-cost delta of Mm RIGID macro moves evaluated jointly: all of a
+    proposal's members sit at their NEW positions (and displaced
+    occupants at the members' old positions) simultaneously, so
+    intra-macro nets see a ~zero delta under pure translation — summing
+    per-member pairwise deltas would over-charge every chain link by
+    ~2*q*D and freeze the macros.
+
+    blocks/occs [Mm, Lm] (pads -1), newpos [Mm, Lm, 2], memv [Mm, Lm].
+    Returns delta [Mm]."""
+    Mm, Lm = blocks.shape
+    F = pp.blk_net.shape[1]
+    bc = jnp.clip(blocks, 0)
+    oc = jnp.clip(occs, 0)
+    bnets = jnp.where(memv[:, :, None], pp.blk_net[bc], -1)
+    onets = jnp.where((occs >= 0)[:, :, None], pp.blk_net[oc], -1)
+    nets = jnp.concatenate([bnets, onets], axis=1).reshape(Mm, -1)
+    # dedupe within a proposal (a net touching two members must count
+    # its delta once): sort, mask repeats
+    nets = jnp.sort(nets, axis=1)
+    rep = jnp.concatenate(
+        [jnp.zeros((Mm, 1), bool), nets[:, 1:] == nets[:, :-1]], axis=1)
+    nets = jnp.where(rep, -1, nets)
+    nvalid = nets >= 0
+    netsc = jnp.clip(nets, 0)
+    pblk = pp.net_blk[netsc]                       # [Mm, 2LmF, P]
+    pvalid = pp.net_valid[netsc] & nvalid[:, :, None]
+    px = pos[jnp.clip(pblk, 0), 0]
+    py = pos[jnp.clip(pblk, 0), 1]
+    # member / occupant membership with slot recovery
+    eq_m = (pblk[:, :, :, None] == bc[:, None, None, :]) \
+        & memv[:, None, None, :]
+    is_m = eq_m.any(axis=3)
+    mi = jnp.argmax(eq_m, axis=3)                  # member slot
+    eq_o = (pblk[:, :, :, None] == oc[:, None, None, :]) \
+        & (occs >= 0)[:, None, None, :]
+    is_o = eq_o.any(axis=3) & ~is_m
+    oi = jnp.argmax(eq_o, axis=3)
+    m_new_x = jnp.take_along_axis(
+        newpos[:, :, 0], mi.reshape(Mm, -1), axis=1).reshape(mi.shape)
+    m_new_y = jnp.take_along_axis(
+        newpos[:, :, 1], mi.reshape(Mm, -1), axis=1).reshape(mi.shape)
+    # occupant i takes member i's OLD position
+    o_old_x = jnp.take_along_axis(
+        pos[bc, 0], oi.reshape(Mm, -1), axis=1).reshape(oi.shape)
+    o_old_y = jnp.take_along_axis(
+        pos[bc, 1], oi.reshape(Mm, -1), axis=1).reshape(oi.shape)
+    npx = jnp.where(is_m, m_new_x, jnp.where(is_o, o_old_x, px))
+    npy = jnp.where(is_m, m_new_y, jnp.where(is_o, o_old_y, py))
+    big = jnp.int32(10 ** 6)
+
+    def bbsum(ax, ay):
+        xmin = jnp.where(pvalid, ax, big).min(axis=2)
+        xmax = jnp.where(pvalid, ax, -big).max(axis=2)
+        ymin = jnp.where(pvalid, ay, big).min(axis=2)
+        ymax = jnp.where(pvalid, ay, -big).max(axis=2)
+        q = pp.net_q[netsc]
+        return q * ((xmax - xmin + 1) + (ymax - ymin + 1)).astype(
+            jnp.float32)
+
+    return jnp.where(nvalid, bbsum(npx, npy) - bbsum(px, py),
+                     0.0).sum(axis=1)              # [Mm]
+
+
+@functools.partial(jax.jit, static_argnames=("Mm", "Lm"))
+def macro_step(pp: PlaceProblem, mac_blocks, mac_len, pos, ring_idx, occ,
+               key, t, rlim, inv_bb, Mm: int, Lm: int):
+    """Batched rigid macro moves (place_macro.c semantics): propose Mm
+    vertical relocations of whole carry-chain macros; each member i
+    pairwise-swaps with the occupant of target site (x', y0+i).
+    Occupied-by-macro targets and site conflicts are rejected via the
+    same lowest-index site-claim rule as single moves; Metropolis on the
+    summed member deltas.  Interior (CLB-column) macros only — carry
+    chains never contain IO blocks."""
+    NM = mac_blocks.shape[0]
+    NB = pp.num_blocks
+    NS = pp.num_sites
+    kp, kc, ky, ka = jax.random.split(key, 4)
+    mi = jax.random.randint(kp, (Mm,), 0, NM)
+    blocks = mac_blocks[mi]                            # [Mm, Lm] pad -1
+    L = mac_len[mi]                                    # [Mm]
+    memv = (jnp.arange(Lm)[None, :] < L[:, None]) & (blocks >= 0)
+    b0 = jnp.clip(blocks[:, 0], 0)
+    rl = jnp.maximum(1, rlim.astype(jnp.int32))
+
+    tid = pp.type_id[b0]
+    nc = pp.ncols[tid]
+    rl_col = jnp.maximum(1, (rl * nc) // jnp.int32(pp.nx))
+    u = jax.random.uniform(kc, (Mm,), minval=-1.0, maxval=1.0)
+    ci0 = pp.col_idx_of_x[tid, pos[b0, 0]]
+    ci = jnp.clip(ci0 + jnp.round(u * rl_col.astype(jnp.float32))
+                  .astype(jnp.int32), 0, nc - 1)
+    cx = pp.col_list[tid, ci]                          # [Mm]
+    dy = jax.random.randint(ky, (Mm,), -rl, rl + 1)
+    y0 = jnp.clip(pos[b0, 1] + dy, 1, pp.ny - L + 1)
+    ty = y0[:, None] + jnp.arange(Lm)[None, :]         # [Mm, Lm]
+
+    bc = jnp.clip(blocks, 0)
+    src = (pos[bc, 1] - 1) * pp.nx + (pos[bc, 0] - 1)  # [Mm, Lm]
+    dst = (ty - 1) * pp.nx + (cx[:, None] - 1)
+    src = jnp.where(memv, src, NS)
+    dst = jnp.where(memv, dst, NS)
+    occ_p1 = jnp.concatenate([occ, jnp.full((1,), -1, occ.dtype)])
+    o = jnp.where(memv, occ_p1[jnp.clip(dst, 0, NS)], -1)  # [Mm, Lm]
+    # an occupant that IS a member of this macro means the runs overlap
+    o_frozen = (o >= 0) & pp.frozen[jnp.clip(o, 0)]
+    self_move = (dst == src).all(axis=1)
+
+    idx = jnp.arange(Mm, dtype=jnp.int32)
+    claim = jnp.full(NS + 1, Mm, jnp.int32)
+    claim = claim.at[src].min(idx[:, None])
+    claim = claim.at[dst].min(idx[:, None])
+    won = jnp.where(memv,
+                    (claim[src] == idx[:, None])
+                    & (claim[dst] == idx[:, None]), True)
+    own = (won.all(axis=1) & ~self_move & ~o_frozen.any(axis=1)
+           & (jnp.where(memv, ty, 1) <= pp.ny).all(axis=1) & (L > 0))
+
+    # joint rigid delta (intra-macro nets translate for free)
+    newpos = jnp.stack([jnp.broadcast_to(cx[:, None], ty.shape), ty],
+                       axis=2)                     # [Mm, Lm, 2]
+    occs = jnp.where(memv, o, -1)
+    delta = _macro_delta_bb(pp, pos, jnp.where(memv, bc, -1), occs,
+                            newpos, memv)
+    flat_b = jnp.where(memv, bc, 0).reshape(-1)
+    flat_o = occs.reshape(-1)
+    u2 = jax.random.uniform(ka, (Mm,))
+    accept = own & ((delta * inv_bb <= 0)
+                    | (u2 < jnp.exp(-delta * inv_bb
+                                    / jnp.maximum(t, 1e-30))))
+
+    accm = accept[:, None] & memv
+    bb_sc = jnp.where(accm, bc, NB).reshape(-1)
+    oo_sc = jnp.where(accm & (o >= 0), o, NB).reshape(-1)
+    pos2 = jnp.concatenate([pos, jnp.zeros((1, 3), pos.dtype)], axis=0)
+    newp = jnp.concatenate(
+        [newpos, jnp.zeros((Mm, Lm, 1), pos.dtype)], axis=2).reshape(-1, 3)
+    oldp = pos[bc].reshape(-1, 3)
+    pos2 = pos2.at[bb_sc].set(newp)
+    pos2 = pos2.at[oo_sc].set(oldp)
+    ssrc = jnp.where(accm, src, NS).reshape(-1)
+    sdst = jnp.where(accm, dst, NS).reshape(-1)
+    occ2 = occ.at[ssrc].set(flat_o, mode="drop")
+    occ2 = occ2.at[sdst].set(flat_b, mode="drop")
+    return pos2[:NB], ring_idx, occ2, accept.sum()
+
+
 class PlacerTiming:
     """Bundle wiring the placer to the timing subsystem: the delay-lookup
     matrices plus the STA machinery for criticality recomputation
@@ -580,13 +743,33 @@ class Placer:
 
     def __init__(self, pnl: PackedNetlist, grid: DeviceGrid,
                  opts: Optional[PlacerOpts] = None,
-                 timing: Optional[PlacerTiming] = None):
+                 timing: Optional[PlacerTiming] = None,
+                 macros=None):
         self.pnl, self.grid = pnl, grid
         self.opts = opts or PlacerOpts()
         self.timing = timing
+        # a chain taller than the grid splits into column-height
+        # segments (the reference's multi-column carry handling reduced
+        # to its placement effect: each segment stays contiguous)
+        self.macros = []
+        for m in (macros or []):
+            for lo in range(0, len(m), max(2, grid.ny)):
+                seg = m[lo:lo + max(2, grid.ny)]
+                if len(seg) >= 2:
+                    self.macros.append(seg)
         self.pp = build_place_problem(
-            pnl, grid, lookup=timing.lookup if timing else None)
+            pnl, grid, lookup=timing.lookup if timing else None,
+            macros=self.macros)
         self._ring_of = _ring_index_host(grid)
+        self._mac_blocks = self._mac_len = None
+        if self.macros:
+            Lm = max(len(m) for m in self.macros)
+            mb = np.full((len(self.macros), Lm), -1, dtype=np.int32)
+            for i, m in enumerate(self.macros):
+                mb[i, :len(m)] = m
+            self._mac_blocks = jnp.asarray(mb)
+            self._mac_len = jnp.asarray(
+                np.array([len(m) for m in self.macros], dtype=np.int32))
 
     def _state_from_pos(self, pos_np: np.ndarray):
         pp = self.pp
@@ -620,6 +803,11 @@ class Placer:
         tt = jnp.float32(opts.timing_tradeoff if self.timing else 0.0)
         M = min(opts.moves_per_step, max(8, NB))
         steps = max(1, math.ceil(opts.inner_num * NB ** (4 / 3) / M))
+        if self.macros:
+            # macro-align the initial placement (place_macro.c initial
+            # macro placement): members occupy vertical runs
+            from .macros import align_initial
+            pos0 = align_initial(self.pnl, self.grid, pos0, self.macros)
         pos, ring, occ = self._state_from_pos(pos0)
         key = jax.random.PRNGKey(opts.seed)
 
@@ -665,6 +853,18 @@ class Placer:
                 jnp.float32(t), jnp.float32(rlim),
                 jnp.float32(exit_t), M, steps, n_temps,
                 self.timing is not None)
+            # rigid macro relocations ride along once per segment
+            # (place_macro.c try_swap-for-macros; async dispatches)
+            if self._mac_blocks is not None:
+                Lm = int(self._mac_blocks.shape[1])
+                Mm = min(32, max(4, len(self.macros)))
+                inv_bb_m = jnp.float32(1.0 / max(bb_cost, 1e-30))
+                for _ in range(4):
+                    key, k2 = jax.random.split(key)
+                    pos, ring, occ, _ = macro_step(
+                        pp, self._mac_blocks, self._mac_len, pos, ring,
+                        occ, k2, jnp.float32(t), jnp.float32(rlim),
+                        inv_bb_m, Mm, Lm)
             # ONE host sync per segment
             t, rlim, na_a, nv_a, bb_a, live_a, ts_a, rl_a = \
                 jax.device_get((t_d, rlim_d, na_a, nv_a, bb_a, live_a,

@@ -2,13 +2,19 @@
 
 Layer map (reference equivalents):
   device_graph  — ELL rr-graph upload (new_rr_graph.h mirror, init.cxx)
-  search        — batched Bellman-Ford relaxation + traceback (dijkstra.h,
-                  delta_stepping.h, route_tree.c)
-  router        — PathFinder outer loop / rip-up-reroute driver
+  planes        — structured scan/shift relaxation over [B, W, X, Y]
+                  wire grids + window-fused multi-iteration driver
+                  program (the flagship search; dijkstra.h,
+                  delta_stepping.h, route_tree.c work-efficiency target)
+  search        — gather-based ELL relaxation (fallback + oracle)
+  router        — PathFinder outer loop / windowed rip-up-reroute driver
                   (route_timing.c:85, partitioning_multi_sink…cxx:5937)
   check         — legality oracle (check_route.c)
+  qor           — crit-path parity harness vs the serial oracle
 """
 
 from .check import RouteError, check_route
 from .device_graph import DeviceRRGraph, to_device
+from .planes import PlanesGraph, build_planes
+from .qor import QorRow, qor_compare
 from .router import RouteResult, Router, RouterOpts, RouteStats

@@ -963,42 +963,58 @@ def route_window_planes(
     G = sel_plan.shape[0]
 
     def it_body(it, st):
-        occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes = st
+        (occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes,
+         nexec) = st
         force = (it0 + it) < force_until
 
         def g_step(g, st2):
-            occ2, paths2, sink_delay2, all_reached2, bb2, nr = st2
-            (paths2, sink_delay2, all_reached2, bb2, occ2,
-             n_act) = _step_core(
-                pg, dev, occ2, acc, pres,
-                paths2, sink_delay2, all_reached2, bb2,
-                source_all, sinks_all, crit_all,
-                opin_node_all, entry_cell_all, entry_oidx_all,
-                entry_delay_all,
-                sink_cell_all, sink_ipin_all, sink_wdelay_all,
-                sel_plan[g], valid_plan[g], force, full_bb,
-                nsweeps, max_len, num_waves, group, doubling, mesh)
-            return (occ2, paths2, sink_delay2, all_reached2, bb2,
-                    nr + n_act)
+            def run(st3):
+                occ2, paths2, sink_delay2, all_reached2, bb2, nr, ng = st3
+                (paths2, sink_delay2, all_reached2, bb2, occ2,
+                 n_act) = _step_core(
+                    pg, dev, occ2, acc, pres,
+                    paths2, sink_delay2, all_reached2, bb2,
+                    source_all, sinks_all, crit_all,
+                    opin_node_all, entry_cell_all, entry_oidx_all,
+                    entry_delay_all,
+                    sink_cell_all, sink_ipin_all, sink_wdelay_all,
+                    sel_plan[g], valid_plan[g], force, full_bb,
+                    nsweeps, max_len, num_waves, group, doubling, mesh)
+                return (occ2, paths2, sink_delay2, all_reached2, bb2,
+                        nr + n_act, ng + 1)
 
-        occ, paths, sink_delay, all_reached, bb, nroutes = lax.fori_loop(
+            # skip pow2-padding groups and fully-clean groups outright
+            # (the group plan is padded to a power of two to bound the
+            # compiled-program count; without the cond every pad group
+            # would still pay the full relax).  ng counts the groups that
+            # actually executed, so relax-step stats reflect real work
+            over_g = jnp.append(st2[0] > dev.capacity, False)
+            sel_g = sel_plan[g]
+            any_dirty = (valid_plan[g]
+                         & (over_g[st2[1][sel_g]].any(axis=(1, 2))
+                            | ~st2[3][sel_g] | force)).any()
+            return lax.cond(any_dirty, run, lambda s: s, st2)
+
+        (occ, paths, sink_delay, all_reached, bb, nroutes,
+         nexec) = lax.fori_loop(
             0, G, g_step,
-            (occ, paths, sink_delay, all_reached, bb, nroutes))
+            (occ, paths, sink_delay, all_reached, bb, nroutes, nexec))
         # PathFinder history/present escalation once per iteration
         acc = acc + acc_fac * jnp.maximum(
             occ - dev.capacity, 0).astype(jnp.float32)
         pres = jnp.minimum(max_pres, pres * pres_mult)
-        return occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes
+        return (occ, acc, paths, sink_delay, all_reached, bb, pres,
+                nroutes, nexec)
 
-    (occ, acc, paths, sink_delay, all_reached, bb, pres,
-     nroutes) = lax.fori_loop(
+    (occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes,
+     nexec) = lax.fori_loop(
         0, K_iters, it_body,
         (occ, acc, paths, sink_delay, all_reached, bb, pres0,
-         jnp.int32(0)))
+         jnp.int32(0), jnp.int32(0)))
 
     rrm, colors = _mis_colors(dev, occ, paths, all_reached,
                               topk, n_colors)
     over = jnp.maximum(occ - dev.capacity, 0)
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
             colors, (over > 0).sum(dtype=jnp.int32),
-            over.sum(dtype=jnp.int32), nroutes)
+            over.sum(dtype=jnp.int32), nroutes, nexec)

@@ -218,6 +218,20 @@ def _spatial_order(idx: np.ndarray, cx: np.ndarray, cy: np.ndarray,
     return idx[order[deal]]
 
 
+def _order_and_chunk(g, nsinks, cx, cy, nx, ny, B):
+    """Shared batch formation: fanout classes (similar wave depth),
+    spatial round-robin within a class, chunked to B (used by both the
+    window planner and the ELL per-iteration loop)."""
+    if len(g) == 0:
+        return []
+    cls = np.ceil(np.log2(np.maximum(
+        1, nsinks[g]).astype(float))).astype(np.int64)
+    ordered = np.concatenate([
+        _spatial_order(g[cls == c], cx, cy, nx, ny)
+        for c in sorted(set(cls.tolist()), reverse=True)])
+    return [ordered[lo:lo + B] for lo in range(0, len(ordered), B)]
+
+
 def _pad_to(a: np.ndarray, B: int, fill) -> np.ndarray:
     n = a.shape[0]
     if n == B:
@@ -325,16 +339,8 @@ class Router:
             cd = colors[dirty]
             groups = [dirty[cd == c] for c in np.unique(cd)]
         for g in groups:
-            if len(g) == 0:
-                continue
-            cls = np.ceil(np.log2(np.maximum(
-                1, nsinks[g]).astype(float))).astype(np.int64)
-            ordered = np.concatenate([
-                _spatial_order(g[cls == c], cx, cy,
-                               self.rr.grid.nx, self.rr.grid.ny)
-                for c in sorted(set(cls.tolist()), reverse=True)])
-            batches.extend(ordered[lo:lo + B]
-                           for lo in range(0, len(ordered), B))
+            batches.extend(_order_and_chunk(
+                g, nsinks, cx, cy, self.rr.grid.nx, self.rr.grid.ny, B))
         if not batches:
             batches = [np.zeros(0, dtype=np.int64)]
         # pad the group count to a power of two: G is a traced shape, so
@@ -440,18 +446,22 @@ class Router:
             occ, acc, paths, sink_delay, all_reached, bb = out[:6]
             force_all_next = False
             # the ONE sync per window
-            rrm, colors, n_over, over_total, nroutes = (
+            rrm, colors, n_over, over_total, nroutes, nexec = (
                 np.asarray(v) for v in jax.device_get(
-                    (out[7], out[8], out[9], out[10], out[11])))
+                    (out[7], out[8], out[9], out[10], out[11],
+                     out[12])))
             n_over, over_total = int(n_over), int(over_total)
             it_done += K
-            G = sel_plan.shape[0]
+            # nexec = groups that actually executed on device (pad and
+            # clean groups skip), so the step counter reflects real work
+            w_steps = int(nexec) * waves * nsweeps
             result.total_net_routes += int(nroutes)
-            result.total_relax_steps += K * G * waves * nsweeps
+            result.total_relax_steps += w_steps
             result.stats.append(RouteStats(
                 it_done, n_over, over_total, len(dirty),
-                time.time() - t0, relax_steps=K * G * waves * nsweeps,
-                batches=G, overuse_pct=100.0 * n_over / max(1, N)))
+                time.time() - t0, relax_steps=w_steps,
+                batches=int(nexec),
+                overuse_pct=100.0 * n_over / max(1, N)))
             pres = min(opts.max_pres_fac,
                        pres * opts.pres_fac_mult ** K)
             if opts.stats_dir and opts.dump_routes:
@@ -645,7 +655,8 @@ class Router:
 
             if it > 1 and len(idx) > 1 and n_over > 0:
                 I = _pow2_at_least(len(idx))
-                K = _pow2_at_least(min(max(n_over, 1), 4096))
+                # cap at N: lax.top_k rejects k > dimension size
+                K = min(_pow2_at_least(min(max(n_over, 1), 4096)), N)
                 idx_pad = _pad_to(idx.astype(np.int32), I, -1)
                 conflict = np.asarray(conflict_subset(
                     dev, occ, paths, jnp.asarray(idx_pad), K))
@@ -665,16 +676,9 @@ class Router:
                 parts = ((g[~wide[g]], g[wide[g]]) if win is not None
                          else (g,))
                 for gp in parts:
-                    if len(gp) == 0:
-                        continue
-                    cls = np.ceil(np.log2(np.maximum(
-                        1, nsinks_np[gp]).astype(float))).astype(np.int64)
-                    ordered = np.concatenate([
-                        _spatial_order(gp[cls == c], cx_np, cy_np,
-                                       rr.grid.nx, rr.grid.ny)
-                        for c in sorted(set(cls.tolist()), reverse=True)])
-                    batches.extend(ordered[lo:lo + B]
-                                   for lo in range(0, len(ordered), B))
+                    batches.extend(_order_and_chunk(
+                        gp, nsinks_np, cx_np, cy_np, rr.grid.nx,
+                        rr.grid.ny, B))
 
             # one static wave cap for every batch: the wave loop is a
             # device while_loop that exits early once all sinks are done,

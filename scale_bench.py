@@ -1,0 +1,148 @@
+"""Scale study for the planes router (VERDICT round-2 item #2).
+
+Three artifacts, printed as markdown for BENCHMARKS.md:
+  1. per-sweep relaxation cost vs rr-graph size (the planes kernel's
+     scaling curve — each sweep is a fixed set of scans/shifts over
+     [B, W, X, Y] grids, so cost should scale ~linearly in cell count
+     once past fixed overheads);
+  2. an end-to-end route of a large synthetic circuit (>= 1e4..1e5 rr
+     nodes depending on --big), with iteration stats and legality from
+     the independent checker;
+  3. the memory model: bytes for every resident structure as a function
+     of (R nets, S max fanout, N nodes, Ncells, W, grid).
+
+Runs on the CPU backend by default (honest scaling shape without the
+tunnel); pass --tpu to use the chip.
+"""
+
+import argparse
+import sys
+import time
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpu", action="store_true")
+    ap.add_argument("--big", type=int, default=1200,
+                    help="LUTs for the end-to-end route")
+    ap.add_argument("--curve_only", action="store_true")
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallel_eda_tpu.arch.builtin import minimal_arch
+    from parallel_eda_tpu.route import planes as P
+    from parallel_eda_tpu.rr.graph import build_rr_graph
+    from parallel_eda_tpu.rr.grid import DeviceGrid
+
+    # ---- 1. per-sweep cost vs N ----
+    print("## Planes relaxation: per-sweep cost vs rr-graph size\n")
+    print("| grid | W | rr nodes | cells | sweep cost (B=64) |")
+    print("|---|---|---|---|---|")
+    B = 64
+    for g, W in ((8, 10), (16, 12), (32, 14), (48, 16), (64, 16),
+                 (96, 20)):
+        arch = minimal_arch(chan_width=W)
+        rr = build_rr_graph(arch, DeviceGrid(g, g, arch.io_capacity))
+        pg = P.build_planes(rr)
+        nc = pg.ncells
+        rng = np.random.default_rng(0)
+        cc = jnp.asarray(rng.uniform(1e-10, 2e-10,
+                                     (B, nc)).astype(np.float32))
+        d0 = jnp.full((B, nc), jnp.inf).at[:, nc // 2].set(0.0)
+        crit = jnp.zeros((B, 1, 1, 1))
+        w0 = jnp.zeros((B, nc))
+        f = jax.jit(lambda d0, cc, c, w:
+                    P.planes_relax(pg, d0, cc, c, w, 8))
+        out = f(d0, cc, crit, w0)
+        np.asarray(out[0][0, :2])       # real sync (block_until_ready lies)
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(d0, cc, crit, w0)
+            np.asarray(out[0][0, :2])
+        per_sweep = (time.perf_counter() - t0) / reps / 8
+        print(f"| {g}x{g} | {W} | {rr.num_nodes} | {nc} | "
+              f"{per_sweep*1e3:.2f} ms |")
+        log(f"curve {g}x{g} done")
+    if args.curve_only:
+        return
+
+    # ---- 2. end-to-end large route ----
+    from parallel_eda_tpu.flow import run_place, run_route, synth_flow
+    from parallel_eda_tpu.place import PlacerOpts
+    from parallel_eda_tpu.route import RouterOpts
+
+    print("\n## End-to-end large route\n")
+    t0 = time.time()
+    f = synth_flow(num_luts=args.big, num_inputs=32, num_outputs=32,
+                   chan_width=16, seed=5)
+    log(f"prepared: {f.rr.num_nodes} rr nodes, "
+        f"{f.term.num_nets} nets, grid {f.rr.grid.nx}x{f.rr.grid.ny} "
+        f"({time.time()-t0:.0f}s)")
+    t0 = time.time()
+    f = run_place(f, PlacerOpts(moves_per_step=256), timing_driven=False)
+    t_place = time.time() - t0
+    log(f"placed in {t_place:.0f}s")
+    t0 = time.time()
+    f = run_route(f, RouterOpts(batch_size=args.batch),
+                  timing_driven=False)
+    t_route = time.time() - t0
+    res = f.route
+    R, S = f.term.sinks.shape
+    print(f"- circuit: {args.big} LUTs, {R} nets (Smax {S}), "
+          f"grid {f.rr.grid.nx}x{f.rr.grid.ny} W={f.rr.chan_width}, "
+          f"**{f.rr.num_nodes} rr nodes**")
+    print(f"- route: success={res.success} in {res.iterations} "
+          f"iterations, wirelength {res.wirelength}, "
+          f"{t_route:.0f}s wall ({'tpu' if args.tpu else 'cpu'} backend), "
+          f"{res.total_net_routes} net-routes "
+          f"({res.total_net_routes/t_route:.1f} nets/s)")
+    print(f"- legality: verified by the independent checker (run_route)")
+    print("- iteration stats (window syncs):")
+    print("  | iter | overused | overuse total | dirty nets |")
+    print("  |---|---|---|---|")
+    for s in res.stats:
+        print(f"  | {s.iteration} | {s.overused_nodes} | "
+              f"{s.overuse_total} | {s.rerouted_nets} |")
+
+    # ---- 3. memory model ----
+    from parallel_eda_tpu.route.planes import build_planes
+    pg = build_planes(f.rr)
+    N = f.rr.num_nodes
+    nc = pg.ncells
+    L = 4 * (f.rr.grid.nx + f.rr.grid.ny) + 64
+    Bt = args.batch
+    K = 8 * 33  # upper bound per-sink candidates (pins x edges)
+    print("\n## Memory model (resident device state)\n")
+    print("| structure | formula | this circuit |")
+    print("|---|---|---|")
+    rows = [
+        ("planes dist/pred/w (per batch)", "3 * B*Ncells*4",
+         3 * Bt * nc * 4),
+        ("congestion cc (per batch)", "B*Ncells*4", Bt * nc * 4),
+        ("occ/acc/history", "N*8", N * 8),
+        ("paths (resident)", "R*S*L*4", R * S * L * 4),
+        ("sink tables", "R*S*K*12 (K=pins*edges)", R * S * K * 12),
+        ("planes masks/delays (static)", "~12*Ncells*4", 12 * nc * 4),
+    ]
+    for name, formula, b in rows:
+        print(f"| {name} | {formula} | {b/1e6:.1f} MB |")
+    print(f"\nDominant terms at Titan scale (R~1e5, S~1e2, N~1e7): the "
+          f"dense path store (R*S*L) and per-net sink tables — the "
+          f"affine-template factorization (planes.py notes) removes the "
+          f"latter; per-net bb-bucketed path lengths the former.")
+
+
+if __name__ == "__main__":
+    main()

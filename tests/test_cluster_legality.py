@@ -1,0 +1,79 @@
+"""Intra-cluster routability (VERDICT round-2 item #9;
+pack/cluster_legality.c semantics): under a sparse crossbar the packer
+must reject clusters whose signals cannot be matched onto populated
+switch points; the full crossbar stays the zero-cost fast path."""
+
+import numpy as np
+
+from parallel_eda_tpu.arch.builtin import minimal_arch
+from parallel_eda_tpu.netlist.generate import generate_circuit
+from parallel_eda_tpu.pack.packer import (cluster_routable, pack_netlist,
+                                          _form_bles, _xbar_allowed)
+
+
+def test_full_crossbar_is_fast_path():
+    arch = minimal_arch()
+    nl = generate_circuit(num_luts=20, num_inputs=4, num_outputs=4,
+                          K=arch.K, seed=1)
+    bles = _form_bles(nl)
+    assert cluster_routable(bles, set(range(min(4, len(bles)))),
+                            set(nl.clocks), arch)
+
+
+def test_sparse_crossbar_rejects_infeasible_cluster():
+    arch = minimal_arch()
+    nl = generate_circuit(num_luts=30, num_inputs=6, num_outputs=6,
+                          K=arch.K, seed=2)
+    bles = _form_bles(nl)
+    clocks = set(nl.clocks)
+    # at some density, some candidate cluster of this circuit must be
+    # infeasible while the full crossbar accepts it — scan densities
+    # until a rejection is found (the exact threshold depends on the
+    # pattern; the property under test is reject-vs-accept behavior)
+    found_reject = False
+    for dens in (0.05, 0.1, 0.2, 0.3):
+        arch.xbar_density = dens
+        for lo in range(0, len(bles) - arch.N, arch.N):
+            mem = set(range(lo, lo + arch.N))
+            if not cluster_routable(bles, mem, clocks, arch):
+                found_reject = True
+                break
+        if found_reject:
+            break
+    assert found_reject, "no cluster rejected at any tested density"
+
+
+def test_sparse_pack_produces_routable_clusters():
+    arch = minimal_arch()
+    nl = generate_circuit(num_luts=30, num_inputs=6, num_outputs=6,
+                          K=arch.K, seed=3)
+    arch.xbar_density = 1.0
+    full = pack_netlist(nl, arch)
+    arch.xbar_density = 0.35
+    sparse = pack_netlist(nl, arch)
+    bles = _form_bles(nl)
+    clocks = set(nl.clocks)
+    # block.prims lists primitive ids; map them back to BLE indices
+    ble_of_prim = {}
+    for bi, b in enumerate(bles):
+        if b.lut is not None:
+            ble_of_prim[b.lut] = bi
+        if b.ff is not None:
+            ble_of_prim[b.ff] = bi
+    n_clb = 0
+    for b in sparse.blocks:
+        if b.type_name != "clb" or not b.prims:
+            continue
+        n_clb += 1
+        mem = {ble_of_prim[p] for p in b.prims}
+        assert cluster_routable(bles, mem, clocks, arch)
+    assert n_clb > 0
+    # the sparse constraint costs capacity: at least as many CLBs
+    full_clbs = sum(1 for b in full.blocks if b.type_name == "clb")
+    assert n_clb >= full_clbs
+
+
+def test_pattern_density():
+    hits = sum(_xbar_allowed(p, j, k, 0.5)
+               for p in range(20) for j in range(8) for k in range(6))
+    assert 0.35 < hits / (20 * 8 * 6) < 0.65

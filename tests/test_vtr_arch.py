@@ -1,0 +1,67 @@
+"""A genuine VTR7-schema architecture file as a committed fixture
+(tests/golden/k6_frac_n10_mem.xml — k6_frac_N10-class: fracturable-LUT
+fle tree with modes, crossbar interconnect with delay annotations,
+length-4 unidir segments, a single_port_ram memory column), parsed by
+read_arch_xml and driven through the FULL flow: pack -> place -> route
+-> STA, with the file's timing numbers feeding the analysis.
+(VERDICT round-2 item #10; read_xml_arch_file.c:2528 semantics.)"""
+
+import os
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.xml_parser import read_arch_xml
+
+FIX = os.path.join(os.path.dirname(__file__), "golden",
+                   "k6_frac_n10_mem.xml")
+
+
+def test_parse_k6_frac_n10():
+    arch = read_arch_xml(FIX)
+    # cluster geometry from the pb_type tree
+    assert arch.K == 6 and arch.N == 10 and arch.I == 33
+    assert arch.io_capacity == 8
+    # segments: one length-4 type wired through switch "0"
+    assert len(arch.segments) == 1
+    seg = arch.segments[0]
+    assert seg.length == 4
+    assert seg.Rmetal == 101.0 and abs(seg.Cmetal - 22.5e-15) < 1e-20
+    assert abs(arch.switches[seg.wire_switch].Tdel - 58e-12) < 1e-15
+    # fc fractions from the clb's own <fc>
+    assert abs(arch.Fc_in - 0.15) < 1e-9 and abs(arch.Fc_out - 0.10) < 1e-9
+    # timing annotations from the file (crossbar + LUT delays, FF setup)
+    clb = arch.clb_type
+    assert clb.T_comb >= 2.61e-10          # the LUT delay_matrix max
+    assert abs(clb.T_setup - 6.6e-11) < 1e-15
+    assert abs(clb.T_clk_to_q - 1.24e-10) < 1e-15
+    # memory column: hard type + subckt model + gridlocations cols
+    mem = arch.block_type("memory")
+    assert mem.num_input_pins == 15 and mem.num_output_pins == 8
+    assert arch.hard_models.get("single_port_ram") == "memory"
+    assert any(c.type_name == "memory" and c.start == 4 and c.repeat == 6
+               for c in arch.column_types)
+
+
+def test_flow_on_vtr_arch():
+    from parallel_eda_tpu.flow import prepare, run_place, run_route
+    from parallel_eda_tpu.netlist.generate import generate_circuit
+    from parallel_eda_tpu.route import RouterOpts
+
+    arch = read_arch_xml(FIX)
+    nl = generate_circuit(num_luts=25, num_inputs=6, num_outputs=6,
+                          K=arch.K, seed=4)
+    # explicit 8x8 interior so the length-4 segments actually span 4
+    # tiles (auto-sizing would pick a 2x2 grid for 3 CLBs)
+    f = prepare(nl, arch, chan_width=20, nx=8, ny=8, seed=4)
+    # the builder consumed the file's segments: length-4 wires exist
+    from parallel_eda_tpu.rr.graph import CHANX
+    wires = f.rr.node_type == CHANX
+    spans = (f.rr.xhigh - f.rr.xlow + 1)[wires]
+    assert spans.max() == 4
+    f = run_place(f)
+    f = run_route(f, RouterOpts(batch_size=32))
+    assert f.route.success
+    # STA consumed the file's timing: a finite, plausible crit path
+    assert np.isfinite(f.crit_path_delay)
+    assert f.crit_path_delay > 2.61e-10    # at least one LUT delay
