@@ -284,8 +284,10 @@ def run_route(flow: FlowResult, opts: Optional[RouterOpts] = None,
             flow.analyzer = TimingAnalyzer(flow.tg, sdc=flow.sdc)
     router = Router(flow.rr, opts, mesh=mesh)
     t0 = time.time()
-    cb = flow.analyzer.timing_cb if timing_driven else None
-    flow.route = router.route(flow.term, timing_cb=cb)
+    # timing-driven: the planes program fuses the per-iteration STA on
+    # device (analyzer mode, K>1 windows); ELL falls back to the host cb
+    flow.route = router.route(
+        flow.term, analyzer=flow.analyzer if timing_driven else None)
     flow.times["route"] = time.time() - t0
     if timing_driven:
         flow.analyzer.analyze(flow.route.sink_delay)

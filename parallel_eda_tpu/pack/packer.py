@@ -70,35 +70,44 @@ def _ble_criticalities(bles: List[_BLE], producers: Dict[str, int]):
     # combinational edges u -> v: v consumes u's output and u is NOT
     # registered (a FF output starts a fresh path)
     succ: List[List[int]] = [[] for _ in range(nble)]
+    indeg = [0] * nble
     for v, b in enumerate(bles):
         for n in b.inputs:
             u = producers.get(n)
             if u is not None and bles[u].ff is None:
                 succ[u].append(v)
+                indeg[v] += 1
+    # single-pass longest path over a topological order (Kahn), O(V+E) —
+    # the fixpoint-relaxation this replaced was O(depth * E), which a
+    # 10^4-BLE carry-chain circuit turns into minutes of host time
+    from collections import deque
+    order: List[int] = []
+    q = deque(v for v in range(nble) if indeg[v] == 0)
+    work = indeg[:]
+    while q:
+        u = q.popleft()
+        order.append(u)
+        for v in succ[u]:
+            work[v] -= 1
+            if work[v] == 0:
+                q.append(v)
+    if len(order) != nble:
+        # a combinational cycle (LUT loop with no FF) is a malformed
+        # netlist; the timing-graph build rejects it the same way
+        raise ValueError("combinational loop in BLE graph")
     arr = [0] * nble
-    # longest path via repeated relaxation (DAG; nble passes worst case,
-    # but depth passes suffice — iterate until fixpoint)
-    changed = True
-    guard = 0
-    while changed and guard <= nble:
-        changed = False
-        guard += 1
-        for u in range(nble):
-            for v in succ[u]:
-                if arr[v] < arr[u] + 1:
-                    arr[v] = arr[u] + 1
-                    changed = True
+    for u in order:
+        au1 = arr[u] + 1
+        for v in succ[u]:
+            if arr[v] < au1:
+                arr[v] = au1
     req_from = [0] * nble
-    changed = True
-    guard = 0
-    while changed and guard <= nble:
-        changed = False
-        guard += 1
-        for u in range(nble):
-            for v in succ[u]:
-                if req_from[u] < req_from[v] + 1:
-                    req_from[u] = req_from[v] + 1
-                    changed = True
+    for u in reversed(order):
+        best = 0
+        for v in succ[u]:
+            if req_from[v] >= best:
+                best = req_from[v] + 1
+        req_from[u] = best
     dmax = max((arr[v] + req_from[v] for v in range(nble)), default=0)
     if dmax == 0:
         return [0.0] * nble
@@ -227,23 +236,18 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
             return float(conn)
         return alpha * tgain * 10.0 + (1.0 - alpha) * conn
 
-    def cluster_inputs(members: Set[int], cand: Optional[int] = None) -> int:
-        mem = set(members)
-        if cand is not None:
-            mem.add(cand)
-        outs = {bles[m].output for m in mem}
-        ext: Set[str] = set()
-        for m in mem:
-            for n in bles[m].inputs:
-                if n not in clocks and n not in outs:
-                    ext.add(n)
-        return len(ext)
+    # static seed order: crit desc, degree desc, index asc (cluster.c
+    # get_seed_logical_molecule_with_most_critical_inputs semantics; crit
+    # and degree never change, so one sort replaces the per-cluster
+    # O(nble) max scan)
+    seed_order = sorted(range(nble),
+                        key=lambda b: (-crit[b], -degree[b], b))
+    seed_ptr = 0
 
     while unclustered:
-        # seed with the most critical unclustered BLE (cluster.c
-        # get_seed_logical_molecule_with_most_critical_inputs), degree as
-        # the tiebreak (and the whole criterion when not timing-driven)
-        seed = max(unclustered, key=lambda b: (crit[b], degree[b], -b))
+        while seed_order[seed_ptr] not in unclustered:
+            seed_ptr += 1
+        seed = seed_order[seed_ptr]
         if not cluster_routable(bles, {seed}, clocks, arch):
             # a lone BLE that cannot route through the cluster crossbar
             # means the netlist does not fit this arch at all — error
@@ -255,24 +259,52 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
         members: Set[int] = {seed}
         unclustered.remove(seed)
         clk = bles[seed].clock
+        # incrementally-maintained cluster state (identical to the
+        # from-scratch recomputation it replaced, O(deg) per step):
+        # outs = member outputs, ext = external input nets,
+        # cands = unclustered BLEs adjacent to any member
+        outs: Set[str] = set()
+        ext: Set[str] = set()
+        cands: Set[int] = set()
+
+        def absorb(m: int):
+            b = bles[m]
+            outs.add(b.output)
+            ext.discard(b.output)
+            for n in b.inputs:
+                if n not in clocks and n not in outs:
+                    ext.add(n)
+            for n in b.inputs:
+                p = producers.get(n)
+                if p is not None and p in unclustered:
+                    cands.add(p)
+            for c in consumers.get(b.output, []):
+                if c in unclustered:
+                    cands.add(c)
+            cands.discard(m)
+
+        def inputs_with(cand: int) -> int:
+            """|external inputs| if cand joined (exact recomputation
+            semantics: cand's output leaves ext, its non-clock inputs
+            join unless already internal)."""
+            b = bles[cand]
+            n = len(ext) - (1 if b.output in ext else 0)
+            seen: Set[str] = set()
+            for s in b.inputs:
+                if (s not in clocks and s not in outs and s != b.output
+                        and s not in ext and s not in seen):
+                    seen.add(s)
+                    n += 1
+            return n
+
+        absorb(seed)
         while len(members) < N:
-            # candidates: unclustered BLEs adjacent to the cluster
-            cands: Set[int] = set()
-            for m in members:
-                b = bles[m]
-                for n in b.inputs:
-                    p = producers.get(n)
-                    if p is not None and p in unclustered:
-                        cands.add(p)
-                for c in consumers.get(b.output, []):
-                    if c in unclustered:
-                        cands.add(c)
             best, best_score = None, -1.0
             for c in sorted(cands):
                 bc = bles[c]
                 if bc.clock is not None and clk is not None and bc.clock != clk:
                     continue
-                if cluster_inputs(members, c) > I:
+                if inputs_with(c) > I:
                     continue
                 if not cluster_routable(bles, members | {c}, clocks,
                                         arch):
@@ -287,7 +319,7 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
                     bc = bles[c]
                     if bc.clock is not None and clk is not None and bc.clock != clk:
                         continue
-                    if (cluster_inputs(members, c) <= I
+                    if (inputs_with(c) <= I
                             and cluster_routable(bles, members | {c},
                                                  clocks, arch)):
                         best = c
@@ -296,6 +328,7 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
                 break
             members.add(best)
             unclustered.remove(best)
+            absorb(best)
             if clk is None:
                 clk = bles[best].clock
         clusters.append(sorted(members))

@@ -240,84 +240,101 @@ class PlanesTerminals:
     sink_delay: np.ndarray      # f32  [R, S, K] delay wire->IPIN->SINK
 
 
+def _ragged_flat(row_ptr: np.ndarray, nodes: np.ndarray):
+    """Flatten the CSR slices row_ptr[n]:row_ptr[n+1] for every n in
+    ``nodes``: returns (edge_idx [T], owner [T]) where owner[t] is the
+    position in ``nodes`` the edge belongs to.  owner is nondecreasing,
+    so per-owner running indices come from one cumsum."""
+    deg = row_ptr[nodes + 1] - row_ptr[nodes]
+    tot = int(deg.sum())
+    owner = np.repeat(np.arange(len(nodes)), deg)
+    off = np.arange(tot) - np.repeat(np.cumsum(deg) - deg, deg)
+    return np.repeat(row_ptr[nodes], deg) + off, owner
+
+
+def _within(owner: np.ndarray, n_owners: int):
+    """Running index of each element within its (nondecreasing) owner."""
+    cnt = np.bincount(owner, minlength=n_owners)
+    return (np.arange(len(owner))
+            - np.repeat(np.cumsum(cnt) - cnt, cnt)), cnt
+
+
 def build_planes_terminals(rr: RRGraph, source: np.ndarray,
                            sinks: np.ndarray, cell_of_node: np.ndarray,
                            ncells: int) -> PlanesTerminals:
     """source [R], sinks [R, S] (-1 pad) -> terminal tables.  `ncells` is
     the table pad value (one past the last real cell: the batch step pads
     its dist arrays with one INF slot there — out-of-range pads would hit
-    take_along_axis's NaN fill and poison every argmin)."""
+    take_along_axis's NaN fill and poison every argmin).
+
+    Fully vectorized (two-level ragged CSR flattening): the candidate
+    order is identical to the per-net/per-sink loop it replaced (edge
+    order within each row), so routing stays bit-deterministic; host
+    build time is O(total edges touched) numpy work, which is what lets
+    a 10^4-LUT circuit prepare in seconds (round-3 VERDICT item 6)."""
     R = len(source)
     S = sinks.shape[1]
     N = rr.num_nodes
 
     orp, odst, osw = rr.out_row_ptr, rr.out_dst, rr.out_switch
     irp, isrc, idel = rr.in_row_ptr, rr.in_src, rr.in_delay
+    src = np.asarray(source, dtype=np.int64)
 
-    opins_per_net, entries_per_net = [], []
-    for r in range(R):
-        s = int(source[r])
-        ops = odst[orp[s]:orp[s + 1]]
-        ents = []
-        for oi, o in enumerate(ops):
-            lo, hi = orp[o], orp[o + 1]
-            wires = odst[lo:hi]
-            esw = osw[lo:hi].astype(np.int64)
-            d = (rr.switch_Tdel[esw] + rr.C[wires]
-                 * (rr.switch_R[esw] + 0.5 * rr.R[wires]))
-            for w, dd in zip(wires, d):
-                ents.append((int(cell_of_node[w]), oi, float(dd)))
-        opins_per_net.append(ops)
-        entries_per_net.append(ents)
-
-    # sink side: cache per sink NODE (shared classes repeat across nets)
-    cache = {}
-
-    def sink_cands(sk):
-        if sk in cache:
-            return cache[sk]
-        out = []
-        for e in range(irp[sk], irp[sk + 1]):
-            ip = int(isrc[e])
-            w1 = float(idel[e])
-            for e2 in range(irp[ip], irp[ip + 1]):
-                wire = int(isrc[e2])
-                out.append((int(cell_of_node[wire]), ip,
-                            w1 + float(idel[e2])))
-        cache[sk] = out
-        return out
-
-    O = max(1, max(len(o) for o in opins_per_net))
-    Ko = max(1, max(len(e) for e in entries_per_net))
-    K = 1
-    for r in range(R):
-        for s in range(S):
-            if sinks[r, s] >= 0:
-                K = max(K, len(sink_cands(int(sinks[r, s]))))
-
+    # --- SOURCE side: net -> OPINs -> wire entries ---
+    e1, net_of_op = _ragged_flat(orp, src)          # source out-edges
+    op_nodes = odst[e1].astype(np.int64)            # [To] OPIN nodes
+    oi_of_op, deg_o = _within(net_of_op, R)
+    O = max(1, int(deg_o.max()) if R else 1)
     opin_node = np.full((R, O), N, dtype=np.int32)
+    opin_node[net_of_op, oi_of_op] = op_nodes
+
+    e2, op_of_e = _ragged_flat(orp, op_nodes)       # OPIN -> wire edges
+    wires = odst[e2].astype(np.int64)
+    esw = osw[e2].astype(np.int64)
+    edel = (rr.switch_Tdel[esw] + rr.C[wires]
+            * (rr.switch_R[esw] + 0.5 * rr.R[wires])).astype(np.float32)
+    net_of_e = net_of_op[op_of_e]
+    ki, ent_cnt = _within(net_of_e, R)
+    Ko = max(1, int(ent_cnt.max()) if R else 1)
     entry_cell = np.full((R, Ko), ncells, dtype=np.int32)
     entry_oidx = np.zeros((R, Ko), dtype=np.int32)
     entry_delay = np.zeros((R, Ko), dtype=np.float32)
-    sink_cell = np.full((R, S, K), ncells, dtype=np.int32)
-    sink_ipin = np.full((R, S, K), N, dtype=np.int32)
-    sink_delay = np.zeros((R, S, K), dtype=np.float32)
-    for r in range(R):
-        ops, ents = opins_per_net[r], entries_per_net[r]
-        opin_node[r, :len(ops)] = ops
-        for k, (c, oi, dd) in enumerate(ents):
-            entry_cell[r, k] = c
-            entry_oidx[r, k] = oi
-            entry_delay[r, k] = dd
-        for s in range(S):
-            if sinks[r, s] < 0:
-                continue
-            for k, (c, ip, dd) in enumerate(sink_cands(int(sinks[r, s]))):
-                sink_cell[r, s, k] = c
-                sink_ipin[r, s, k] = ip
-                sink_delay[r, s, k] = dd
+    entry_cell[net_of_e, ki] = cell_of_node[wires]
+    entry_oidx[net_of_e, ki] = oi_of_op[op_of_e]
+    entry_delay[net_of_e, ki] = edel
+
+    # --- SINK side: unique sink nodes -> IPINs -> wire candidates
+    # (shared sink classes repeat across nets; computed once per node) ---
+    sk_flat = sinks.reshape(-1).astype(np.int64)
+    valid = sk_flat >= 0
+    uniq, inv = np.unique(sk_flat[valid], return_inverse=True)
+    U = len(uniq)
+    f1, u_of_1 = _ragged_flat(irp, uniq)            # sink in-edges
+    ipins = isrc[f1].astype(np.int64)
+    w1 = idel[f1].astype(np.float64)
+    f2, p_of_2 = _ragged_flat(irp, ipins)           # ipin in-edges
+    wires2 = isrc[f2].astype(np.int64)
+    wtot = (w1[p_of_2] + idel[f2]).astype(np.float32)
+    u_of_2 = u_of_1[p_of_2]
+    k2, cand_cnt = _within(u_of_2, U)
+    K = max(1, int(cand_cnt.max()) if U else 1)
+    u_cell = np.full((U, K), ncells, dtype=np.int32)
+    u_ipin = np.full((U, K), N, dtype=np.int32)
+    u_del = np.zeros((U, K), dtype=np.float32)
+    u_cell[u_of_2, k2] = cell_of_node[wires2]
+    u_ipin[u_of_2, k2] = ipins[p_of_2]
+    u_del[u_of_2, k2] = wtot
+
+    sink_cell = np.full((R * S, K), ncells, dtype=np.int32)
+    sink_ipin = np.full((R * S, K), N, dtype=np.int32)
+    sink_delay = np.zeros((R * S, K), dtype=np.float32)
+    sink_cell[valid] = u_cell[inv]
+    sink_ipin[valid] = u_ipin[inv]
+    sink_delay[valid] = u_del[inv]
     return PlanesTerminals(opin_node, entry_cell, entry_oidx, entry_delay,
-                           sink_cell, sink_ipin, sink_delay)
+                           sink_cell.reshape(R, S, K),
+                           sink_ipin.reshape(R, S, K),
+                           sink_delay.reshape(R, S, K))
 
 
 
@@ -933,9 +950,10 @@ def _mis_colors(dev: DeviceRRGraph, occ, paths, all_reached,
 @functools.partial(
     jax.jit,
     static_argnames=("K_iters", "nsweeps", "max_len", "num_waves",
-                     "group", "doubling", "topk", "n_colors", "mesh"),
+                     "group", "doubling", "topk", "n_colors", "mesh",
+                     "sta_depth", "crit_exp", "max_crit", "use_sdc"),
     donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
-                     "bb"))
+                     "bb", "crit_all"))
 def route_window_planes(
         pg: PlanesGraph, dev: DeviceRRGraph, occ, acc,
         paths, sink_delay, all_reached, bb,
@@ -946,7 +964,10 @@ def route_window_planes(
         pres0, pres_mult, max_pres, acc_fac, it0, force_until,
         K_iters: int, nsweeps: int, max_len: int, num_waves: int,
         group: int, doubling: bool = True, topk: int = 1024,
-        n_colors: int = 5, mesh=None):
+        n_colors: int = 5, mesh=None,
+        tdev=None, req_seed=None, sta_depth: int = 0,
+        crit_exp: float = 1.0, max_crit: float = 0.99,
+        use_sdc: bool = False):
     """A WINDOW of K_iters complete PathFinder iterations as ONE device
     program: per iteration, every batch group in sel_plan [G, B] runs the
     fused rip-up/route/commit step (clean nets no-op via the device-side
@@ -958,13 +979,26 @@ def route_window_planes(
     re-plans the groups from the device-computed coloring, and dispatches
     the next window.
 
+    Pass ``tdev`` (a timing.sta.DeviceTimingGraph) to run the FULL STA
+    between iterations ON DEVICE: each iteration ends with the forward/
+    backward slack sweeps over the timing DAG and the criticality scatter
+    back into crit_all, so timing-driven negotiation gets multi-iteration
+    windows too (the reference reruns analyze_timing +
+    update_sink_criticalities every router iteration,
+    timing/path_delay.c:1994 via parallel_route/router.cxx:28,42 — here
+    that loop closes inside one XLA program).  crit_all is loop state
+    (donated) and the per-iteration crit-path delays come back in
+    dmax_hist [K_iters].
+
     Returns (occ, acc, paths, sink_delay, all_reached, bb, pres,
-    rrm [R], colors [R], n_over, over_total)."""
+    rrm [R], colors [R], n_over, over_total, nroutes, nexec, crit_all,
+    dmax_hist)."""
     G = sel_plan.shape[0]
+    R, Smax = sinks_all.shape
 
     def it_body(it, st):
         (occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes,
-         nexec) = st
+         nexec, crit_all, dmax_hist) = st
         force = (it0 + it) < force_until
 
         def g_step(g, st2):
@@ -1003,18 +1037,30 @@ def route_window_planes(
         acc = acc + acc_fac * jnp.maximum(
             occ - dev.capacity, 0).astype(jnp.float32)
         pres = jnp.minimum(max_pres, pres * pres_mult)
+        if tdev is not None:
+            # device-resident analyze_timing + update_sink_criticalities
+            from ..timing.sta import sta_crit
+            flat = jnp.append(
+                sink_delay.reshape(-1), jnp.float32(0.0))
+            crit_flat, dmax, _, _ = sta_crit(
+                tdev, flat, sta_depth, crit_exp, max_crit,
+                req_seed=req_seed, use_sdc=use_sdc)
+            crit_all = crit_flat.reshape(R, Smax)
+            dmax_hist = dmax_hist.at[it].set(dmax)
         return (occ, acc, paths, sink_delay, all_reached, bb, pres,
-                nroutes, nexec)
+                nroutes, nexec, crit_all, dmax_hist)
 
     (occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes,
-     nexec) = lax.fori_loop(
+     nexec, crit_all, dmax_hist) = lax.fori_loop(
         0, K_iters, it_body,
         (occ, acc, paths, sink_delay, all_reached, bb, pres0,
-         jnp.int32(0), jnp.int32(0)))
+         jnp.int32(0), jnp.int32(0), crit_all,
+         jnp.full(K_iters, jnp.nan, jnp.float32)))
 
     rrm, colors = _mis_colors(dev, occ, paths, all_reached,
                               topk, n_colors)
     over = jnp.maximum(occ - dev.capacity, 0)
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
             colors, (over > 0).sum(dtype=jnp.int32),
-            over.sum(dtype=jnp.int32), nroutes, nexec)
+            over.sum(dtype=jnp.int32), nroutes, nexec, crit_all,
+            dmax_hist)

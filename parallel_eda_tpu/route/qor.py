@@ -66,6 +66,9 @@ class QorRow:
     serial_wl: int
     device_iters: int
     serial_iters: int
+    # host syncs the device route paid (= windows dispatched; < iters
+    # when the fused on-device STA kept multi-iteration windows alive)
+    device_windows: int = 0
 
     @property
     def cpd_delta_pct(self) -> float:
@@ -85,10 +88,11 @@ def qor_compare(flow, name: str = "circuit",
     rr, term, nl, pnl = flow.rr, flow.term, flow.nl, flow.pnl
     tg = build_timing_graph(nl, pnl, term)
 
-    # --- device: per-iteration criticality feedback (Router.route) ---
+    # --- device: per-iteration criticality feedback, fused on device
+    # (analyzer mode: STA inside the window program, K>1 windows) ---
     ta_d = TimingAnalyzer(tg)
     router = Router(rr, opts or RouterOpts(batch_size=64))
-    res_d = router.route(term, timing_cb=ta_d.timing_cb)
+    res_d = router.route(term, analyzer=ta_d)
     assert res_d.success, "device route failed"
     ta_d.analyze(res_d.sink_delay)
     cpd_d = float(ta_d.crit_path_delay)
@@ -112,4 +116,5 @@ def qor_compare(flow, name: str = "circuit",
             break
         cpd_s, res_s = float(ta_s.crit_path_delay), r
     return QorRow(name, cpd_d, cpd_s, res_d.wirelength, res_s.wirelength,
-                  res_d.iterations, iters_s)
+                  res_d.iterations, iters_s,
+                  device_windows=len(res_d.stats))

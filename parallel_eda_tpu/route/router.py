@@ -357,7 +357,8 @@ class Router:
     # (each device<->host sync costs ~65-70 ms through the tunnel)
     _WINDOWS = (2, 2, 3, 4, 5, 6, 8, 10, 10)
 
-    def _route_planes_windows(self, term, crit, timing_cb, occ, acc,
+    def _route_planes_windows(self, term, crit, timing_cb, analyzer,
+                              occ, acc,
                               paths, sink_delay, all_reached, bb, full_bb,
                               source_d, sinks_d, planes_tbl, nsinks_np,
                               cx_np, cy_np, result, B):
@@ -369,7 +370,15 @@ class Router:
         convergence, plateau widening, and the next window's batch plan.
         Replaces the per-iteration loop (whose per-batch and per-summary
         round trips dominated wall time through the ~65 ms tunnel) and
-        the host O(I^2) coloring (VERDICT round-2 items #1/#6)."""
+        the host O(I^2) coloring (VERDICT round-2 items #1/#6).
+
+        With ``analyzer`` (timing.sta.TimingAnalyzer), the per-iteration
+        STA runs INSIDE the window program (sta.sta_crit fused into
+        route_window_planes), so timing-driven routing keeps K>1
+        multi-iteration windows — criticalities never visit the host
+        during negotiation; only the per-iteration crit-path scalars
+        come back with each window's summary fetch (the reference reruns
+        analyze_timing every iteration, router.cxx:28,42)."""
         from .planes import route_window_planes
 
         opts = self.opts
@@ -378,6 +387,16 @@ class Router:
         N = rr.num_nodes
         grp = Smax if opts.sink_group == 0 else opts.sink_group
         grp = max(1, min(grp, Smax))
+
+        # device-fused STA config (analyzer mode): the full timing sweep
+        # runs between iterations inside the window program
+        sta_kw = {}
+        if analyzer is not None:
+            sta_kw = dict(
+                tdev=analyzer.dev, req_seed=analyzer._req_seed,
+                sta_depth=analyzer.tg.depth, crit_exp=analyzer.crit_exp,
+                max_crit=analyzer.max_crit,
+                use_sdc=analyzer._req_seed is not None)
 
         pres = opts.initial_pres_fac
         crit_d = jnp.asarray(crit)
@@ -403,8 +422,12 @@ class Router:
         widx = 0
         while it_done < opts.max_router_iterations:
             K = self._WINDOWS[min(widx, len(self._WINDOWS) - 1)]
-            if timing_cb is not None or opts.stats_dir:
-                K = 1                 # per-iteration observability/timing
+            if (timing_cb is not None and analyzer is None) \
+                    or opts.stats_dir:
+                # generic host timing callback / per-iteration stats rows
+                # need a sync every iteration; the analyzer path instead
+                # fuses the STA on device and keeps K>1
+                K = 1
             K = min(K, opts.max_router_iterations - it_done)
             widx += 1
 
@@ -442,14 +465,16 @@ class Router:
                 jnp.int32(it_done + 1 if force_all_next
                           else opts.incremental_after),
                 K, nsweeps, self.max_len, waves, grp_w,
-                doubling, min(4096, N), 5, self.mesh)
+                doubling, min(4096, N), 5, self.mesh, **sta_kw)
             occ, acc, paths, sink_delay, all_reached, bb = out[:6]
             force_all_next = False
-            # the ONE sync per window
-            rrm, colors, n_over, over_total, nroutes, nexec = (
+            # the ONE sync per window (dmax_hist rides along: the
+            # per-iteration crit-path delays from the fused STA)
+            rrm, colors, n_over, over_total, nroutes, nexec, dmax_hist = (
                 np.asarray(v) for v in jax.device_get(
                     (out[7], out[8], out[9], out[10], out[11],
-                     out[12])))
+                     out[12], out[14])))
+            crit_d = out[13]            # donated in; stays device-resident
             n_over, over_total = int(n_over), int(over_total)
             it_done += K
             # nexec = groups that actually executed on device (pad and
@@ -457,11 +482,16 @@ class Router:
             w_steps = int(nexec) * waves * nsweeps
             result.total_net_routes += int(nroutes)
             result.total_relax_steps += w_steps
+            cpd = float(dmax_hist[K - 1]) if analyzer is not None \
+                else float("nan")
             result.stats.append(RouteStats(
                 it_done, n_over, over_total, len(dirty),
                 time.time() - t0, relax_steps=w_steps,
                 batches=int(nexec),
-                overuse_pct=100.0 * n_over / max(1, N)))
+                overuse_pct=100.0 * n_over / max(1, N),
+                crit_path_delay=cpd))
+            if analyzer is not None and cpd == cpd:
+                analyzer.crit_path_delay = cpd
             pres = min(opts.max_pres_fac,
                        pres * opts.pres_fac_mult ** K)
             if opts.stats_dir and opts.dump_routes:
@@ -506,7 +536,7 @@ class Router:
                 dirty = np.arange(R)
                 force_all_next = True
                 full_reroute_done = True
-            if timing_cb is not None:
+            if timing_cb is not None and analyzer is None:
                 result.sink_delay = np.asarray(sink_delay)
                 crit = np.minimum(np.asarray(
                     timing_cb(result), dtype=np.float32), 0.99)
@@ -530,12 +560,20 @@ class Router:
     def route(self, term: NetTerminals,
               crit: Optional[np.ndarray] = None,
               timing_cb: Optional[Callable[["RouteResult"], np.ndarray]]
-              = None) -> RouteResult:
+              = None, analyzer=None) -> RouteResult:
         """Route all nets.  crit [R, Smax] per-sink criticalities (0 =>
         pure congestion-driven).  timing_cb, if given, is called after each
         iteration with the current result and must return updated per-sink
         criticalities (the analyze_timing / update_sink_criticalities hook,
-        parallel_route/router.cxx:28,42)."""
+        parallel_route/router.cxx:28,42).
+
+        ``analyzer`` (timing.sta.TimingAnalyzer) is the preferred
+        timing-driven hookup: the planes window program fuses the full
+        STA on device between iterations (no host sync per iteration,
+        K>1 windows); for the ELL program it degrades to the per-
+        iteration host callback."""
+        if analyzer is not None and self.pg is None and timing_cb is None:
+            timing_cb = analyzer.timing_cb
         opts = self.opts
         rr, dev = self.rr, self.dev
         R, Smax = term.sinks.shape
@@ -633,9 +671,9 @@ class Router:
         result = RouteResult(False, 0, None, None, None, 0)
         if self.pg is not None:
             return self._route_planes_windows(
-                term, crit, timing_cb, occ, acc, paths, sink_delay,
-                all_reached, bb, full_bb, source_d, sinks_d, planes_tbl,
-                nsinks_np, cx_np, cy_np, result, B)
+                term, crit, timing_cb, analyzer, occ, acc, paths,
+                sink_delay, all_reached, bb, full_bb, source_d, sinks_d,
+                planes_tbl, nsinks_np, cx_np, cy_np, result, B)
         if win is not None:
             result.windowed_nets = int((~wide).sum())
         n_over = -1                      # previous iteration's overuse

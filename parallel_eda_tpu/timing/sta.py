@@ -55,14 +55,19 @@ def to_device(tg: TimingGraph) -> DeviceTimingGraph:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "crit_exp",
-                                             "max_crit", "use_sdc"))
-def sta_sweep(dev: DeviceTimingGraph, route_delay: jnp.ndarray,
-              depth: int, crit_exp: float = 1.0, max_crit: float = 0.99,
-              req_seed: jnp.ndarray = None, use_sdc: bool = False
-              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
-                         jnp.ndarray]:
-    """route_delay: flat [R*Smax + 1] routed per-connection delays with a
+def sta_crit(dev: DeviceTimingGraph, route_delay: jnp.ndarray,
+             depth: int, crit_exp: float = 1.0, max_crit: float = 0.99,
+             req_seed: jnp.ndarray = None, use_sdc: bool = False
+             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                        jnp.ndarray]:
+    """Traceable STA core (jit-wrapped below as sta_sweep; also inlined
+    into the router's fused window program, route/planes.py
+    route_window_planes, so timing-driven negotiation needs no host
+    round trip per iteration — the analyze_timing-every-iteration loop
+    of the reference, path_delay.c:1994 via parallel_route/router.cxx:28,
+    with the analysis running on device between PathFinder iterations).
+
+    route_delay: flat [R*Smax + 1] routed per-connection delays with a
     trailing 0.0 slot so ridx == -1 gathers a zero.
 
     Single-clock mode (use_sdc=False, path_delay.c default): endpoint
@@ -139,6 +144,10 @@ def sta_sweep(dev: DeviceTimingGraph, route_delay: jnp.ndarray,
     crit_flat = jnp.zeros(RS + 1, jnp.float32).at[idx.ravel()].max(
         jnp.where(ok, crit, 0.0).ravel())
     return crit_flat[:RS], dmax, worst, arr
+
+
+sta_sweep = functools.partial(jax.jit, static_argnames=(
+    "depth", "crit_exp", "max_crit", "use_sdc"))(sta_crit)
 
 
 class TimingAnalyzer:

@@ -24,3 +24,9 @@ def test_crit_path_parity_mult6():
         f"(+{row.cpd_delta_pct:.2f}%)")
     # wirelength stays in the same quality class
     assert row.wl_delta_pct <= 15.0
+    # the fused on-device STA must keep multi-iteration windows alive in
+    # timing-driven mode (K>1: fewer host syncs than iterations; the
+    # round-3 timing_cb => K=1 gate is gone)
+    assert row.device_windows < row.device_iters, (
+        f"timing-driven route paid one sync per iteration "
+        f"({row.device_windows} windows / {row.device_iters} iters)")
