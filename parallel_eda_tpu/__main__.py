@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timing_tradeoff", type=float, default=0.5,
                    help="timing vs wirelength weight in placement "
                    "(0 = pure wirelength)")
+    p.add_argument("--power", action="store_true",
+                   help="estimate power after routing (power.c "
+                        "power_total equivalent)")
+    p.add_argument("--gen_postsynthesis_netlist", action="store_true",
+                   help="write post-synthesis Verilog + SDF "
+                        "(verilog_writer.c equivalent)")
     p.add_argument("--settings_file", default="",
                    help="file of 'flag value' lines used as defaults "
                    "(base/read_settings.c); explicit CLI flags win")
@@ -290,7 +296,14 @@ def main(argv=None) -> int:
             drawn.append(p2)
         print("drew " + " ".join(drawn))
 
+    if args.power and flow.route is not None:
+        from .power import estimate_power
+        print(estimate_power(flow))
+
     paths = save_artifacts(flow, args.out_dir)
+    if args.gen_postsynthesis_netlist:
+        from .netlist.verilog import write_post_synthesis
+        paths.update(write_post_synthesis(flow, args.out_dir))
     print("wrote " + " ".join(sorted(paths.values())))
     print(f"total flow time {time.time() - t_flow:.2f}s")
     return 0

@@ -75,3 +75,22 @@ def minimal_arch(K: int = 4, N: int = 2, I: int = 6,
         make_clb_type(index=1, K=K, N=N, I=I),
     ]
     return arch
+
+
+def unidir_arch(K: int = 4, N: int = 2, I: int = 6,
+                io_capacity: int = 2, chan_width: int = 12,
+                length: int = 1) -> Arch:
+    """Minimal arch with single-driver unidirectional wires (the modern
+    VTR/Titan directionality, reference rr_graph.c:432-548
+    UNI_DIRECTIONAL): even tracks run INC, odd DEC, wires are driven
+    only at their start through the segment mux."""
+    arch = minimal_arch(K=K, N=N, I=I, io_capacity=io_capacity,
+                        chan_width=chan_width)
+    arch.name = "minimal_unidir"
+    arch.segments = [SegmentInf(name=f"l{length}", length=length,
+                                directionality="unidir")]
+    # unidir reaches fewer wires per pin position (starts only): keep
+    # Fc generous so IO pads stay richly connected
+    arch.Fc_out = 0.5
+    arch.Fc_in = 0.5
+    return arch

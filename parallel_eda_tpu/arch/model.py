@@ -38,6 +38,11 @@ class SegmentInf:
     # index of the switch used between wires of this segment type
     wire_switch: int = 0
     opin_switch: int = 0
+    # "bidir" (VPR4-style bidirectional wires, tri-state switches) or
+    # "unidir" (single-driver directed wires, mux switches — every modern
+    # VTR/Titan arch; reference rr_graph.c:432-548 UNI_DIRECTIONAL).
+    # The rr builder requires all segments to agree.
+    directionality: str = "bidir"
 
 
 @dataclass

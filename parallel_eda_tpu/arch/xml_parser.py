@@ -70,6 +70,13 @@ def read_arch_xml(path: str) -> Arch:
             a = seg.attrib
             mux = seg.find("mux")
             wire_switch = _switch_index(mux.attrib.get("name")) if mux is not None else 0
+            # VTR schema: type="unidir" (single-driver, <mux>) vs
+            # type="bidir" (<wire_switch>/<opin_switch> children);
+            # a bare <mux> child also implies unidir
+            # (read_xml_arch_file.c ProcessSegments UNI_DIRECTIONAL)
+            dir_attr = a.get("type", "").lower()
+            if dir_attr not in ("unidir", "bidir"):
+                dir_attr = "unidir" if mux is not None else "bidir"
             segments.append(SegmentInf(
                 name=a.get("name", f"seg{len(segments)}"),
                 length=int(float(a.get("length", 1))),
@@ -78,6 +85,7 @@ def read_arch_xml(path: str) -> Arch:
                 Cmetal=_f(a, "Cmetal", 20e-15),
                 wire_switch=wire_switch,
                 opin_switch=wire_switch,
+                directionality=dir_attr,
             ))
     if not segments:
         segments = [SegmentInf()]

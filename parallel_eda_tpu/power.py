@@ -1,0 +1,238 @@
+"""Power estimation.
+
+TPU-native equivalent of the reference power subsystem
+(vpr/SRC/power/power.c power_total and its component breakdown:
+power_usage_routing :762 / power_usage_blocks :592 / power_usage_clock
+:627; VersaPower model).  Re-designed around what this framework actually
+has on hand instead of transistor-level SPICE curves:
+
+  * Switching activities are computed from the LUT truth tables (the
+    reference reads an ACE .act file): exact signal probabilities under
+    input independence (minterm sums) and transition densities via the
+    Boolean-difference rule  D(f) = sum_i P(df/dx_i) * D(x_i) — both
+    vectorized over the 2^K truth-table masks with numpy.  FF outputs
+    toggle at 2*p*(1-p) per cycle; sequential feedback loops are relaxed
+    for a few iterations.  Primary inputs default to p=0.5, D=0.5 and
+    the clock to p=0.5, D=2 (power.h CLOCK_PROB / CLOCK_DENS).
+  * Routing dynamic power uses the ACTUAL ROUTED wire capacitance: the
+    per-net rr-node C from the route trees (plus switch input loads),
+    0.5 * C * Vdd^2 * f * density per net — the reference walks its
+    route trees the same way (power_usage_routing).
+  * Block power: per-primitive internal switched capacitance plus
+    per-block leakage constants.  Clock power: H-tree estimate over the
+    grid (spine + per-row ribs + per-tile buffer, power_usage_clock
+    semantics).
+
+Outputs a component breakdown report like the reference's power report.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .netlist.netlist import (PRIM_FF, PRIM_HARD, PRIM_INPAD, PRIM_LUT,
+                              PRIM_OUTPAD, LogicalNetlist)
+from .netlist.verilog import lut_mask
+from .rr.graph import CHANX as _CHANX, CHANY as _CHANY
+
+
+@dataclass
+class PowerOpts:
+    """Technology constants (power_cmos_tech.c stand-ins, 40 nm-ish)."""
+    Vdd: float = 0.9                # volts
+    f_clk: float = 100e6            # Hz (activity is per clock cycle)
+    # per-primitive internal switched capacitance (F per output toggle)
+    C_lut_internal: float = 8e-15
+    C_ff_internal: float = 4e-15
+    C_hard_internal: float = 200e-15
+    # leakage per instance (W)
+    P_leak_lut: float = 15e-9
+    P_leak_ff: float = 6e-9
+    P_leak_hard: float = 600e-9
+    P_leak_wire_buf: float = 2e-9   # per used routing switch
+    # clock tree (per-tile rib/spine capacitance + buffer)
+    C_clock_per_tile: float = 30e-15
+    # primary-input defaults (ACE defaults; power.h CLOCK_PROB/DENS)
+    pi_prob: float = 0.5
+    pi_density: float = 0.5
+    clock_density: float = 2.0
+    # per-switch input capacitance if the arch switches give none
+    C_switch_in: float = 5e-15
+
+
+@dataclass
+class PowerReport:
+    total: float
+    dynamic: float
+    leakage: float
+    # component -> (dynamic W, leakage W)
+    components: Dict[str, tuple] = field(default_factory=dict)
+    # per-net density diagnostics
+    avg_density: float = 0.0
+
+    def __str__(self) -> str:
+        lines = ["Power estimation (power.c power_total equivalent):",
+                 f"  total   {self.total * 1e3:10.4f} mW",
+                 f"  dynamic {self.dynamic * 1e3:10.4f} mW",
+                 f"  leakage {self.leakage * 1e3:10.4f} mW"]
+        for k, (d, l) in sorted(self.components.items()):
+            lines.append(f"    {k:<10} dyn {d * 1e3:9.4f} mW   "
+                         f"leak {l * 1e3:9.4f} mW")
+        lines.append(f"  avg net transition density "
+                     f"{self.avg_density:.4f} /cycle")
+        return "\n".join(lines)
+
+
+def _lut_tables(K: int):
+    """Bit tables for minterm evaluation: for each input i of K, the
+    minterm indices where x_i = 1 (LSB-first input numbering, matching
+    netlist.verilog.lut_mask)."""
+    idx = np.arange(1 << K)
+    return [(idx >> i) & 1 for i in range(K)]
+
+
+def activities(nl: LogicalNetlist, opts: PowerOpts,
+               iterations: int = 4):
+    """Signal probability + transition density per net (ACE-style).
+    Returns (prob, density) dicts keyed by net name."""
+    prob: Dict[str, float] = {}
+    dens: Dict[str, float] = {}
+    for c in nl.clocks:
+        prob[c] = 0.5
+        dens[c] = opts.clock_density
+    for p in nl.primitives:
+        if p.kind == PRIM_INPAD and p.output not in prob:
+            prob[p.output] = opts.pi_prob
+            dens[p.output] = opts.pi_density
+
+    # seed every driven net so feedback loops have a starting point
+    for n in nl.net_driver:
+        prob.setdefault(n, 0.5)
+        dens.setdefault(n, opts.pi_density)
+
+    bits_cache: Dict[tuple, np.ndarray] = {}
+
+    def lut_bits(p, k):
+        mask = lut_mask(p.truth_table, k)
+        key = (mask, k)
+        if key not in bits_cache:
+            bits_cache[key] = np.array(
+                [(mask >> m) & 1 for m in range(1 << k)], dtype=np.float64)
+        return bits_cache[key]
+
+    for _ in range(iterations):
+        for p in nl.primitives:
+            if p.kind == PRIM_LUT:
+                k = len(p.inputs)
+                if k == 0:
+                    prob[p.output] = float(lut_mask(p.truth_table, 0) & 1)
+                    dens[p.output] = 0.0
+                    continue
+                bits = lut_bits(p, k)
+                xs = _lut_tables(k)
+                pin = np.array([prob.get(n, 0.5) for n in p.inputs])
+                din = np.array([dens.get(n, 0.0) for n in p.inputs])
+                # P(minterm) under independence
+                pm = np.ones(1 << k)
+                for i in range(k):
+                    pm *= np.where(xs[i], pin[i], 1 - pin[i])
+                prob[p.output] = float((bits * pm).sum())
+                # Boolean difference per input: f(x_i=1) xor f(x_i=0)
+                d = 0.0
+                for i in range(k):
+                    hi = bits[(np.arange(1 << k) | (1 << i))]
+                    lo = bits[(np.arange(1 << k) & ~(1 << i))]
+                    diff = np.abs(hi - lo)
+                    # prob of the difference over the OTHER inputs: the
+                    # minterm weights with x_i marginalised out
+                    pm_other = np.ones(1 << k)
+                    for j in range(k):
+                        if j != i:
+                            pm_other *= np.where(xs[j], pin[j], 1 - pin[j])
+                    p_diff = float((diff * pm_other).sum()) / 2.0
+                    d += p_diff * din[i]
+                dens[p.output] = min(d, opts.clock_density)
+            elif p.kind == PRIM_FF:
+                pd = prob.get(p.inputs[0], 0.5)
+                prob[p.output] = pd
+                dens[p.output] = 2.0 * pd * (1.0 - pd)
+            elif p.kind == PRIM_HARD:
+                pin = [prob.get(n, 0.5) for n in p.inputs if n]
+                for o in p.outputs:
+                    if o:
+                        prob[o] = 0.5
+                        dens[o] = 2.0 * 0.5 * 0.5
+    return prob, dens
+
+
+def estimate_power(flow, opts: Optional[PowerOpts] = None) -> PowerReport:
+    """Full-flow power estimate from a routed FlowResult
+    (vpr_power_estimation, vpr_api.c via main.c:476)."""
+    opts = opts or PowerOpts()
+    nl, rr, term = flow.nl, flow.rr, flow.term
+    prob, dens = activities(nl, opts)
+    V2f = opts.Vdd ** 2 * opts.f_clk
+
+    # --- routing: per-net routed wire capacitance x density ---
+    dyn_route = 0.0
+    leak_route = 0.0
+    n_switch_used = 0
+    net_density = []
+    if flow.route is not None:
+        N = rr.num_nodes
+        paths = flow.route.paths
+        for r, ni in enumerate(term.net_ids):
+            nm = flow.pnl.nets[int(ni)].name
+            d_net = dens.get(nm, opts.pi_density)
+            net_density.append(d_net)
+            seg = paths[r].reshape(-1)
+            nodes = np.unique(seg[seg < N])
+            if not len(nodes):
+                continue
+            wires = nodes[(rr.node_type[nodes] == _CHANX)
+                          | (rr.node_type[nodes] == _CHANY)]
+            C_net = float(rr.C[wires].sum())
+            C_net += len(nodes) * opts.C_switch_in
+            dyn_route += 0.5 * C_net * V2f * d_net
+            n_switch_used += len(wires)
+        leak_route = n_switch_used * opts.P_leak_wire_buf
+
+    # --- blocks ---
+    dyn_blk = 0.0
+    leak_blk = 0.0
+    for p in nl.primitives:
+        if p.kind == PRIM_LUT:
+            d = dens.get(p.output, 0.0)
+            dyn_blk += 0.5 * opts.C_lut_internal * V2f * d
+            leak_blk += opts.P_leak_lut
+        elif p.kind == PRIM_FF:
+            d = dens.get(p.output, 0.0)
+            dyn_blk += 0.5 * opts.C_ff_internal * V2f * d
+            leak_blk += opts.P_leak_ff
+        elif p.kind == PRIM_HARD:
+            d = max((dens.get(o, 0.0) for o in p.outputs if o),
+                    default=0.0)
+            dyn_blk += 0.5 * opts.C_hard_internal * V2f * d
+            leak_blk += opts.P_leak_hard
+
+    # --- clock tree (H-tree over the placed grid) ---
+    n_tiles = (flow.grid.nx + 2) * (flow.grid.ny + 2)
+    n_clocked = sum(1 for p in nl.primitives
+                    if p.kind in (PRIM_FF, PRIM_HARD))
+    C_clk = (n_tiles * opts.C_clock_per_tile
+             + n_clocked * opts.C_ff_internal)
+    dyn_clk = 0.5 * C_clk * V2f * opts.clock_density \
+        if nl.clocks else 0.0
+
+    dynamic = dyn_route + dyn_blk + dyn_clk
+    leakage = leak_route + leak_blk
+    return PowerReport(
+        total=dynamic + leakage, dynamic=dynamic, leakage=leakage,
+        components={"routing": (dyn_route, leak_route),
+                    "blocks": (dyn_blk, leak_blk),
+                    "clock": (dyn_clk, 0.0)},
+        avg_density=float(np.mean(net_density)) if net_density else 0.0,
+    )

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +103,13 @@ class PlanesGraph:
     #   switch): delay with wire_switch of track (t - 1 - parity) mod W
     delay_y_rot0: jnp.ndarray       # f32 [W, NX+1, NY] (parity 0)
     delay_y_rot1: jnp.ndarray       # f32 [W, NX+1, NY] (parity 1)
+    # unidirectional graphs (rr.dir_of_track, rr_graph.c:432-548): every
+    # track has a direction (INC/DEC), wires are driven only at their
+    # start, all edges use the TARGET's switch.  `directional` is static
+    # (it selects a different relaxation program); inc_track is the
+    # per-track INC mask
+    directional: bool = struct.field(pytree_node=False, default=False)
+    inc_track: Optional[jnp.ndarray] = None     # bool [W]
 
     @property
     def shape_x(self):
@@ -213,6 +221,8 @@ def build_planes(rr: RRGraph) -> PlanesGraph:
         first_y=j(first_y), last_y=j(last_y),
         delay_x=j(delay_x), delay_y=j(delay_y),
         delay_y_rot0=j(delay_y_rot0), delay_y_rot1=j(delay_y_rot1),
+        directional=rr.unidir,
+        inc_track=(j(rr.dir_of_track == 0) if rr.unidir else None),
     )
 
 
@@ -414,6 +424,42 @@ def _turn_triples_into_y(pg: PlanesGraph, dx, idxx_canvas, crit_c, cc_y):
                 jnp.where(better, src, bsrc),
                 jnp.where(better, w, bw))
 
+    if pg.directional:
+        # unidir (single-driver): the edge exists iff the SOURCE's
+        # driving end is on the corner AND the TARGET starts there —
+        # an AND of directed gates replaces the bidir endpoint OR.
+        # INC chanx drives from last_x, DEC from first_x; INC chany
+        # starts at first_y (corner below, b=1), DEC at last_y (b=0).
+        # All edges use the target's switch (delay_y).
+        inc = pg.inc_track[:, None, None]
+        cx_src_inc = canvas_x(jnp.where(pg.last_x & inc, dx, INF), INF)
+        cx_src_dec = canvas_x(jnp.where(pg.first_x & ~inc, dx, INF), INF)
+        tgt_of_b = (pg.last_y & ~inc, pg.first_y & inc)
+        for b_off in (0, 1):
+            tgt_gate = tgt_of_b[b_off]
+            par = (xg + (jnp.arange(1, NY + 1)[None, :] - b_off)) % 2
+            for a_off in (0, 1):
+                src_c = cx_src_inc if a_off == 0 else cx_src_dec
+                sl = (slice(None), slice(None),
+                      slice(a_off, a_off + NX + 1),
+                      slice(1 - b_off, 1 - b_off + NY))
+                sli = (slice(None),) + sl[2:]
+                cand = jnp.where(tgt_gate, src_c[sl], INF)
+                cand = cand + crit_c * pg.delay_y + cc_y
+                best, bsrc, bw = fold(best, bsrc, bw, cand,
+                                      ix[sli][None], pg.delay_y)
+                for p in (0, 1):
+                    if (1 + p) % W == 0:
+                        continue
+                    r_src = jnp.roll(src_c, 1 + p, axis=1)[sl]
+                    r_i = jnp.roll(ix, 1 + p, axis=0)[sli][None]
+                    cand = jnp.where(tgt_gate, r_src, INF)
+                    cand = cand + crit_c * pg.delay_y + cc_y
+                    cand = jnp.where(par[None, None] == p, cand, INF)
+                    best, bsrc, bw = fold(best, bsrc, bw, cand, r_i,
+                                          pg.delay_y)
+        return best, bsrc, bw
+
     for b_off in (0, 1):
         tgt_gate = pg.last_y if b_off == 0 else pg.first_y
         par = (xg + (jnp.arange(1, NY + 1)[None, :] - b_off)) % 2
@@ -478,6 +524,40 @@ def _turn_triples_into_x(pg: PlanesGraph, dy, idxy_canvas, crit_c, cc_x):
                 jnp.where(better, src, bsrc),
                 jnp.where(better, w, bw))
 
+    if pg.directional:
+        # unidir mirror: INC chany drives from last_y (b=0, below the
+        # corner), DEC from first_y (b=1); INC chanx starts at first_x
+        # (corner left, a=1), DEC at last_x (a=0).  Target switch
+        # throughout (delay_x, matching the builder's mux-at-start rule).
+        inc = pg.inc_track[:, None, None]
+        cy_src_inc = canvas_y(jnp.where(pg.last_y & inc, dy, INF), INF)
+        cy_src_dec = canvas_y(jnp.where(pg.first_y & ~inc, dy, INF), INF)
+        tgt_of_a = (pg.last_x & ~inc, pg.first_x & inc)
+        for a_off in (0, 1):
+            tgt_gate = tgt_of_a[a_off]
+            par = ((jnp.arange(1, NX + 1)[:, None] - a_off) + yg) % 2
+            for b_off in (0, 1):
+                src_c = cy_src_inc if b_off == 0 else cy_src_dec
+                sl = (slice(None), slice(None),
+                      slice(1 - a_off, 1 - a_off + NX),
+                      slice(b_off, b_off + NY + 1))
+                sli = (slice(None),) + sl[2:]
+                cand = jnp.where(tgt_gate, src_c[sl], INF)
+                cand = cand + crit_c * pg.delay_x + cc_x
+                best, bsrc, bw = fold(best, bsrc, bw, cand,
+                                      iy[sli][None], pg.delay_x)
+                for p in (0, 1):
+                    if (1 + p) % W == 0:
+                        continue
+                    r_src = jnp.roll(src_c, -(1 + p), axis=1)[sl]
+                    r_i = jnp.roll(iy, -(1 + p), axis=0)[sli][None]
+                    cand = jnp.where(tgt_gate, r_src, INF)
+                    cand = cand + crit_c * pg.delay_x + cc_x
+                    cand = jnp.where(par[None, None] == p, cand, INF)
+                    best, bsrc, bw = fold(best, bsrc, bw, cand, r_i,
+                                          pg.delay_x)
+        return best, bsrc, bw
+
     for a_off in (0, 1):
         tgt_gate = pg.last_x if a_off == 0 else pg.first_x
         par = ((jnp.arange(1, NX + 1)[:, None] - a_off) + yg) % 2
@@ -508,7 +588,7 @@ def _turn_triples_into_x(pg: PlanesGraph, dy, idxy_canvas, crit_c, cc_x):
 
 
 def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
-                 nsweeps: int):
+                 nsweeps: int, mesh=None):
     """Fixed-sweep planes relaxation with predecessor tracking.
 
     d0_flat [B, Ncells] seeded initial distances (pred of a seeded cell is
@@ -524,16 +604,35 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     suffice) and relies on the unreached-sink widening retry as the
     safety net.
 
+    With ``mesh`` (a (net, node) jax.sharding.Mesh), the [B, W, X, Y]
+    canvases — the state that grows with device size — are constrained
+    over the mesh: batch on the "net" axis, the X grid axis on the
+    "node" axis (the planes analogue of the reference's spatial rr-graph
+    partition, rr_graph_partitioner.h:840).  The x-direction min-plus
+    scans then run as GSPMD segmented scans with cross-shard prefix
+    exchange (the boundary-node messaging of route.h:330-365, inserted
+    by the compiler), y-scans and track rolls stay shard-local.
+
     Returns (dist_flat, pred_flat, wenter_flat)."""
     B = d0_flat.shape[0]
     W, NX, NYp1 = pg.shape_x
     _, NXp1, NY = pg.shape_y
     ncx = W * NX * NYp1
 
-    dx = d0_flat[:, :ncx].reshape(B, W, NX, NYp1)
-    dy = d0_flat[:, ncx:].reshape(B, W, NXp1, NY)
-    cc_x = cc_flat[:, :ncx].reshape(B, W, NX, NYp1)
-    cc_y = cc_flat[:, ncx:].reshape(B, W, NXp1, NY)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def cshard(t):
+            return lax.with_sharding_constraint(
+                t, NamedSharding(mesh, P("net", None, "node", None)))
+    else:
+        def cshard(t):
+            return t
+
+    dx = cshard(d0_flat[:, :ncx].reshape(B, W, NX, NYp1))
+    dy = cshard(d0_flat[:, ncx:].reshape(B, W, NXp1, NY))
+    cc_x = cshard(cc_flat[:, :ncx].reshape(B, W, NX, NYp1))
+    cc_y = cshard(cc_flat[:, ncx:].reshape(B, W, NXp1, NY))
 
     idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(W, NX, NYp1)
     idxy = (ncx + jnp.arange(W * NXp1 * NY, dtype=jnp.int32)
@@ -543,11 +642,24 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     wx = wenter0[:, :ncx].reshape(B, W, NX, NYp1)
     wy = wenter0[:, ncx:].reshape(B, W, NXp1, NY)
 
-    # scan step costs: pay switch delay + congestion only at span breaks
-    cfx = jnp.where(pg.brk_before_x, crit_c * pg.delay_x + cc_x, 0.0)
-    cbx = jnp.where(pg.brk_after_x, crit_c * pg.delay_x + cc_x, 0.0)
-    cfy = jnp.where(pg.brk_before_y, crit_c * pg.delay_y + cc_y, 0.0)
-    cby = jnp.where(pg.brk_after_y, crit_c * pg.delay_y + cc_y, 0.0)
+    # scan step costs: pay switch delay + congestion only at span breaks.
+    # Unidir: a forward (increasing-coordinate) scan may cross a break
+    # only on INC tracks, a backward scan only on DEC tracks — crossing
+    # against a wire's direction is blocked (INF).  Within-span motion
+    # stays free in both scans (the span is one node).
+    cost_x = crit_c * pg.delay_x + cc_x
+    cost_y = crit_c * pg.delay_y + cc_y
+    if pg.directional:
+        inc = pg.inc_track[:, None, None]
+        cfx = jnp.where(pg.brk_before_x, jnp.where(inc, cost_x, INF), 0.0)
+        cbx = jnp.where(pg.brk_after_x, jnp.where(inc, INF, cost_x), 0.0)
+        cfy = jnp.where(pg.brk_before_y, jnp.where(inc, cost_y, INF), 0.0)
+        cby = jnp.where(pg.brk_after_y, jnp.where(inc, INF, cost_y), 0.0)
+    else:
+        cfx = jnp.where(pg.brk_before_x, cost_x, 0.0)
+        cbx = jnp.where(pg.brk_after_x, cost_x, 0.0)
+        cfy = jnp.where(pg.brk_before_y, cost_y, 0.0)
+        cby = jnp.where(pg.brk_after_y, cost_y, 0.0)
     wfx = jnp.where(pg.brk_before_x, pg.delay_x, 0.0)
     wbx = jnp.where(pg.brk_after_x, pg.delay_x, 0.0)
     wfy = jnp.where(pg.brk_before_y, pg.delay_y, 0.0)
@@ -573,7 +685,10 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
         dx = jnp.where(imp, tv, dx)
         predx = jnp.where(imp, ts, predx)
         wx = jnp.where(imp, tw, wx)
-        return dx, dy, predx, predy, wx, wy
+        # keep the loop-carried canvases pinned to the mesh layout so
+        # GSPMD doesn't migrate them between sweeps
+        return (cshard(dx), cshard(dy), cshard(predx), cshard(predy),
+                cshard(wx), cshard(wy))
 
     dx, dy, predx, predy, wx, wy = lax.fori_loop(
         0, nsweeps, sweep, (dx, dy, predx, predy, wx, wy))
@@ -721,7 +836,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
             0.0)
 
         dist, pred, wenter = planes_relax(pg, d0, cc_flat, crit_c,
-                                          wenter0, nsweeps)
+                                          wenter0, nsweeps, mesh)
 
         # --- sink extraction from the per-net candidate tables ---
         dist_p1 = jnp.concatenate([dist, jnp.full((B, 1), INF)], axis=1)

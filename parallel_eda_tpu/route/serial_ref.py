@@ -41,6 +41,8 @@ class SerialRouteResult:
     route_time_s: float = 0.0
     heap_pops: int = 0           # perf_t.num_heap_pops analogue
     stats: List[dict] = field(default_factory=list)
+    # route() stopped at its deadline_s budget (bench lower-bound mode)
+    timed_out: bool = False
 
 
 class SerialRouter:
@@ -85,7 +87,11 @@ class SerialRouter:
         self.min_wire_cost, _, self.lmax = wire_cost_floor(rr)
 
     def route(self, term: NetTerminals,
-              crit: Optional[np.ndarray] = None) -> SerialRouteResult:
+              crit: Optional[np.ndarray] = None,
+              deadline_s: Optional[float] = None) -> SerialRouteResult:
+        """``deadline_s``: optional wall budget — when exceeded the run
+        stops and returns with timed_out=True (the bench uses the
+        elapsed time as a LOWER BOUND on the serial wall-clock)."""
         rr = self.rr
         N = rr.num_nodes
         R = term.sinks.shape[0]
@@ -108,7 +114,11 @@ class SerialRouter:
                 over_set = occ > self.cap
                 reroute = [i for i in range(R)
                            if any(over_set[v] for v in trees[i])]
-            for i in reroute:
+            for ri, i in enumerate(reroute):
+                if (deadline_s is not None and (ri & 7) == 0
+                        and time.time() - t0 > deadline_s):
+                    res.timed_out = True
+                    break
                 # rip up (pathfinder_update_one_cost -1)
                 for v in trees[i]:
                     occ[v] -= 1
@@ -117,6 +127,9 @@ class SerialRouter:
                 for v in trees[i]:
                     occ[v] += 1
                 pops += self._last_pops
+            if res.timed_out:
+                res.iterations = it
+                break
             over = np.maximum(0, occ - self.cap)
             n_over = int((over > 0).sum())
             res.stats.append({"iteration": it, "overused": n_over,

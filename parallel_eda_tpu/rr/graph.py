@@ -10,12 +10,24 @@ init.cxx:22 init_graph).  Unlike the reference — which builds pointer-rich
 numpy, CSR in both directions (out-edges for push, in-edges for the pull-based
 batched relaxation the TPU router uses).
 
-Graph semantics (island-style, bidirectional wires, subset switch boxes):
+Graph semantics (island-style, subset switch boxes):
   SOURCE -> OPIN -> CHANX/CHANY -> ... -> CHANX/CHANY -> IPIN -> SINK
 Wires of segment length L span L tiles as a single rr-node (xlow..xhigh),
 staggered by track so breaks are distributed; wires connect at their
 endpoints to crossing/continuing wires (Fs=3-style subset pattern) and along
 their span to block IPINs (Fc_in) / from block OPINs (Fc_out).
+
+Two directionality modes (reference rr_graph.c:432-548, the
+UNI_DIRECTIONAL vs BI_DIRECTIONAL segment split):
+  * bidir (VPR4-style): every wire is drivable at both endpoints;
+    wire<->wire edges come in symmetric pairs (tri-state switches).
+  * unidir (every modern VTR/Titan arch): tracks pair by parity —
+    even = INC (left->right / bottom->top), odd = DEC — and every wire
+    has a SINGLE DRIVER at its start: OPINs and switchbox muxes connect
+    only where a wire STARTS, wire->wire edges go from a wire's driving
+    end to a wire starting at that corner (mux switch of the TARGET
+    segment), and only IPIN taps stay span-wide.  W is rounded up to
+    even.
 """
 
 from __future__ import annotations
@@ -76,6 +88,12 @@ class RRGraph:
     # route/planes.py derives its static delay planes from these)
     seg_of_track: Optional[np.ndarray] = None       # int32 [W]
     wire_switch_of_track: Optional[np.ndarray] = None  # int32 [W]
+    # unidir graphs: per-track direction (0 = INC, 1 = DEC); None = bidir
+    dir_of_track: Optional[np.ndarray] = None       # int32 [W]
+
+    @property
+    def unidir(self) -> bool:
+        return self.dir_of_track is not None
 
     @property
     def num_nodes(self) -> int:
@@ -110,16 +128,28 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
     nx, ny = grid.nx, grid.ny
     num_seg = len(arch.segments)
 
+    dirs = {s.directionality for s in arch.segments}
+    if len(dirs) > 1:
+        raise ValueError(f"segments mix directionalities {dirs}; the rr "
+                         f"builder requires one mode (rr_graph.c:432)")
+    unidir = dirs == {"unidir"}
+    if unidir and W % 2:
+        W += 1          # unidir tracks pair INC/DEC; VPR forces even W
+
     # segment type per track: frequency-proportional contiguous blocks
-    seg_of_track = np.zeros(W, dtype=np.int32)
+    # (unidir: assigned per INC/DEC track PAIR so both directions of a
+    # lane share a segment type, rr_graph.c unidir pairing)
+    Wa = W // 2 if unidir else W
+    seg_assign = np.zeros(Wa, dtype=np.int32)
     freqs = np.array([s.frequency for s in arch.segments], dtype=np.float64)
     freqs = freqs / freqs.sum()
-    bounds = np.floor(np.cumsum(freqs) * W + 0.5).astype(np.int64)
+    bounds = np.floor(np.cumsum(freqs) * Wa + 0.5).astype(np.int64)
     lo = 0
     for s, hi in enumerate(bounds):
-        seg_of_track[lo:hi] = s
+        seg_assign[lo:hi] = s
         lo = hi
-    seg_of_track[lo:] = num_seg - 1
+    seg_assign[lo:] = num_seg - 1
+    seg_of_track = np.repeat(seg_assign, 2) if unidir else seg_assign
 
     def type_at(x: int, y: int):
         """Block type on tile (x, y), or None (corner/empty).  Interior
@@ -193,11 +223,17 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                 a = p + 1
         return spans
 
+    def stagger(t: int, L: int) -> int:
+        # unidir: stagger by LANE PAIR so wire starts of each direction
+        # spread over all positions (t % L would give every INC track
+        # the same phase, leaving whole columns with no drive point)
+        return ((t // 2) % L) if unidir else (t % L)
+
     for y in range(ny + 1):
         for t in range(W):
             seg = arch.segments[seg_of_track[t]]
             L = max(1, seg.length)
-            for (a, b) in wire_spans(1, nx, L, t % L):
+            for (a, b) in wire_spans(1, nx, L, stagger(t, L)):
                 span = b - a + 1
                 node = add_node(CHANX, a, y, b, y, t, 1,
                                 seg.Rmetal * span, seg.Cmetal * span,
@@ -207,7 +243,7 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
         for t in range(W):
             seg = arch.segments[seg_of_track[t]]
             L = max(1, seg.length)
-            for (a, b) in wire_spans(1, ny, L, t % L):
+            for (a, b) in wire_spans(1, ny, L, stagger(t, L)):
                 span = b - a + 1
                 node = add_node(CHANY, x, a, x, b, t, 1,
                                 seg.Rmetal * span, seg.Cmetal * span,
@@ -267,6 +303,24 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
             adj = [("x", ny, x)]
         return adj
 
+    def starting_tracks(kind: str, ci: int, pos: int) -> List[int]:
+        """Unidir: tracks whose wire STARTS at this channel position (the
+        only legal drive points; INC starts at its low end, DEC at its
+        high end — rr_graph.c unidir opin/mux placement)."""
+        out = []
+        for t in range(W):
+            w = int(chanx_wire[ci][t, pos] if kind == "x"
+                    else chany_wire[ci][t, pos])
+            if w < 0:
+                continue
+            if kind == "x":
+                start = (xlo[w] == pos) if t % 2 == 0 else (xhi[w] == pos)
+            else:
+                start = (ylo[w] == pos) if t % 2 == 0 else (yhi[w] == pos)
+            if start:
+                out.append(t)
+        return out
+
     for x in range(nx + 2):
         for y in range(ny + 2):
             bt = type_at(x, y)
@@ -282,6 +336,26 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                     fc = arch.fc_frac(W, is_out)
                     pin_ptc = z * bt.num_pins + p
                     for side, (kind, ci, pos) in enumerate(adj):
+                        if unidir and is_out:
+                            # single-driver wires: OPINs drive only wire
+                            # STARTS; spread Fc over the start set
+                            cands = starting_tracks(kind, ci, pos)
+                            if not cands:
+                                continue
+                            fc_abs = min(len(cands),
+                                         max(1, int(round(fc * W))))
+                            st = (pin_ptc * 7 + side * 3) % len(cands)
+                            picks = {cands[(st + (j * len(cands))
+                                            // fc_abs) % len(cands)]
+                                     for j in range(fc_abs)}
+                            for t in sorted(picks):
+                                wire = (chanx_wire[ci][t, pos]
+                                        if kind == "x"
+                                        else chany_wire[ci][t, pos])
+                                sw = arch.segments[
+                                    seg_of_track[t]].opin_switch
+                                add_edge(node, int(wire), sw)
+                            continue
                         for t in _fc_tracks(pin_ptc, side, W, fc):
                             wire = (chanx_wire[ci][t, pos] if kind == "x"
                                     else chany_wire[ci][t, pos])
@@ -317,7 +391,86 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
             return xhigh[w] == x or xlow[w] == x + 1
         return yhigh[w] == y or ylow[w] == y + 1
 
-    for x in range(nx + 1):
+    if unidir:
+        # ---- directed switch box (single-driver rule,
+        # rr_graph.c:432-548): at corner (x, y) every wire whose DRIVING
+        # end lands on the corner (INC ends at its high end, DEC at its
+        # low end) drives wires STARTING at the corner — straight
+        # continuation on the same track, same-index turns, and rotated
+        # turns with the same corner-parity shift as the bidir box (so
+        # the planes kernel keeps its roll structure).  Each edge uses
+        # the TARGET segment's mux switch (the mux belongs to the driven
+        # wire's start).
+        def cxw(t, pos, y):
+            return int(chanx_wire[y][t, pos]) if 1 <= pos <= nx else -1
+
+        def cyw(t, pos, x):
+            return int(chany_wire[x][t, pos]) if 1 <= pos <= ny else -1
+
+        for x in range(nx + 1):
+            for y in range(ny + 1):
+                par = (x + y) % 2
+                shift = (1 + par) % W
+                drv_x = [-1] * W
+                tgt_x = [-1] * W
+                drv_y = [-1] * W
+                tgt_y = [-1] * W
+                for t in range(W):
+                    if t % 2 == 0:              # INC
+                        w = cxw(t, x, y)
+                        if w >= 0 and xhi[w] == x:
+                            drv_x[t] = w
+                        w = cxw(t, x + 1, y)
+                        if w >= 0 and xlo[w] == x + 1:
+                            tgt_x[t] = w
+                        w = cyw(t, y, x)
+                        if w >= 0 and yhi[w] == y:
+                            drv_y[t] = w
+                        w = cyw(t, y + 1, x)
+                        if w >= 0 and ylo[w] == y + 1:
+                            tgt_y[t] = w
+                    else:                       # DEC
+                        w = cxw(t, x + 1, y)
+                        if w >= 0 and xlo[w] == x + 1:
+                            drv_x[t] = w
+                        w = cxw(t, x, y)
+                        if w >= 0 and xhi[w] == x:
+                            tgt_x[t] = w
+                        w = cyw(t, y + 1, x)
+                        if w >= 0 and ylo[w] == y + 1:
+                            drv_y[t] = w
+                        w = cyw(t, y, x)
+                        if w >= 0 and yhi[w] == y:
+                            tgt_y[t] = w
+                for t in range(W):
+                    sw_t = arch.segments[seg_of_track[t]].wire_switch
+                    # straight continuation, same track
+                    if drv_x[t] >= 0 and tgt_x[t] >= 0:
+                        add_edge(drv_x[t], tgt_x[t], sw_t)
+                    if drv_y[t] >= 0 and tgt_y[t] >= 0:
+                        add_edge(drv_y[t], tgt_y[t], sw_t)
+                    # same-index turns
+                    if drv_x[t] >= 0 and tgt_y[t] >= 0:
+                        add_edge(drv_x[t], tgt_y[t], sw_t)
+                    if drv_y[t] >= 0 and tgt_x[t] >= 0:
+                        add_edge(drv_y[t], tgt_x[t], sw_t)
+                    # rotated turns (chanx t -> chany t+shift;
+                    # chany u -> chanx u-shift: the bidir box's symmetric
+                    # pair, kept as two directed rules)
+                    if shift:
+                        ty = (t + shift) % W
+                        if drv_x[t] >= 0 and tgt_y[ty] >= 0:
+                            add_edge(drv_x[t], tgt_y[ty],
+                                     arch.segments[
+                                         seg_of_track[ty]].wire_switch)
+                        tx = (t - shift) % W
+                        if drv_y[t] >= 0 and tgt_x[tx] >= 0:
+                            add_edge(drv_y[t], tgt_x[tx],
+                                     arch.segments[
+                                         seg_of_track[tx]].wire_switch)
+
+    for x in (range(nx + 1) if not unidir else ()):
+        # bidir switch box (the unidir box was emitted above)
         for y in range(ny + 1):
             for t in range(W):
                 sw = arch.segments[seg_of_track[t]].wire_switch
@@ -422,6 +575,8 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
         wire_switch_of_track=np.array(
             [arch.segments[s].wire_switch for s in seg_of_track],
             dtype=np.int32),
+        dir_of_track=(np.arange(W, dtype=np.int32) % 2) if unidir
+        else None,
     )
 
 

@@ -115,6 +115,36 @@ def test_full_route_loop_sharded_matches_single_device():
     check_route(rr, term, res1.paths, occ=res1.occ)
 
 
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+def test_planes_window_sharded_matches_single_device(shape):
+    """The FLAGSHIP program (route_window_planes: fused multi-iteration
+    windows, planes relaxation with spatially sharded [B, W, X, Y]
+    canvases, device MIS coloring, fused STA) on a 2-D mesh must be
+    bit-identical to single-device — net axis = the MPI net partition,
+    node axis = the spatial canvas shard (rr_graph_partitioner.h:840
+    analogue), crit-path feedback device-resident throughout."""
+    from parallel_eda_tpu.timing import TimingAnalyzer, build_timing_graph
+
+    f = synth_flow(num_luts=20, chan_width=10, seed=5)
+    rr, term = f.rr, f.term
+
+    def run(mesh):
+        tg = build_timing_graph(f.nl, f.pnl, term)
+        ta = TimingAnalyzer(tg)
+        r = Router(rr, RouterOpts(batch_size=16), mesh=mesh).route(
+            term, analyzer=ta)
+        return r, ta.crit_path_delay
+
+    res0, cpd0 = run(None)
+    res1, cpd1 = run(make_mesh(8, shape=shape))
+    assert res0.success and res1.success
+    assert res0.iterations == res1.iterations
+    assert np.array_equal(res0.paths, res1.paths)
+    assert np.array_equal(res0.occ, res1.occ)
+    assert np.isclose(cpd0, cpd1, rtol=1e-6)
+    check_route(rr, term, res1.paths, occ=res1.occ)
+
+
 def test_windowed_sharded_matches_single_device():
     """The bb-windowed program under the (net, node) mesh: gather/scatter
     of per-net window tables must shard cleanly and stay bit-identical to

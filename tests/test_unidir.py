@@ -1,0 +1,161 @@
+"""Unidirectional (single-driver) routing architectures.
+
+The reference handles UNI_DIRECTIONAL vs BI_DIRECTIONAL segments in
+rr_graph.c:432-548; every modern VTR/Titan arch is unidir.  Here: the
+builder's directed graph invariants, planes-vs-ELL relaxation parity on
+directed planes (the two independent implementations are each other's
+oracle), full-flow legality/determinism, and crit-path parity vs the
+serial oracle on the same unidir graph.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import unidir_arch
+from parallel_eda_tpu.arch.model import SegmentInf
+from parallel_eda_tpu.flow import prepare, run_place
+from parallel_eda_tpu.netlist.generate import generate_circuit
+from parallel_eda_tpu.netlist.synthesis import array_multiplier
+from parallel_eda_tpu.route.check import check_route
+from parallel_eda_tpu.route.device_graph import to_device
+from parallel_eda_tpu.route.planes import build_planes, planes_relax
+from parallel_eda_tpu.route.qor import qor_compare
+from parallel_eda_tpu.route.router import Router, RouterOpts
+from parallel_eda_tpu.route.search import _relax
+from parallel_eda_tpu.route.serial_ref import SerialRouter
+from parallel_eda_tpu.rr.graph import (CHANX, CHANY, build_rr_graph,
+                                       check_rr_graph)
+from parallel_eda_tpu.rr.grid import DeviceGrid
+
+
+def _mixed_unidir():
+    arch = unidir_arch(chan_width=12)
+    arch.segments = [
+        SegmentInf(name="l1", length=1, frequency=0.4, wire_switch=0,
+                   opin_switch=1, directionality="unidir"),
+        SegmentInf(name="l2", length=2, frequency=0.3, Rmetal=80.0,
+                   Cmetal=15e-15, wire_switch=1, opin_switch=1,
+                   directionality="unidir"),
+        SegmentInf(name="l4", length=4, frequency=0.3, Rmetal=60.0,
+                   Cmetal=12e-15, wire_switch=0, opin_switch=0,
+                   directionality="unidir"),
+    ]
+    return arch
+
+
+@pytest.mark.parametrize("length", [1, 2, 4])
+def test_unidir_builder_invariants(length):
+    """Directed graph sanity: every wire single-driver-reachable, no
+    symmetric wire<->wire edge pairs, all SINKs reachable
+    (check_rr_graph reachability sweep)."""
+    arch = unidir_arch(chan_width=12, length=length)
+    grid = DeviceGrid(nx=6, ny=6, io_capacity=arch.io_capacity)
+    rr = build_rr_graph(arch, grid, chan_width=12)
+    assert rr.unidir
+    check_rr_graph(rr)
+    wires = (rr.node_type == CHANX) | (rr.node_type == CHANY)
+    indeg = np.diff(rr.in_row_ptr)
+    assert int((indeg[wires] == 0).sum()) == 0, "driverless wire"
+    src_ids = np.repeat(np.arange(rr.num_nodes), np.diff(rr.out_row_ptr))
+    ww = wires[src_ids] & wires[rr.out_dst]
+    pairs = set(zip(src_ids[ww].tolist(), rr.out_dst[ww].tolist()))
+    assert not any((b, a) in pairs for (a, b) in pairs), \
+        "symmetric wire edges in a unidir graph"
+
+
+def test_unidir_odd_width_rounds_even():
+    arch = unidir_arch(chan_width=13)
+    grid = DeviceGrid(nx=4, ny=4, io_capacity=arch.io_capacity)
+    rr = build_rr_graph(arch, grid, chan_width=13)
+    assert rr.chan_width == 14
+
+
+def test_unidir_mixed_directionality_rejected():
+    arch = unidir_arch(chan_width=12)
+    arch.segments.append(SegmentInf(name="b", directionality="bidir"))
+    grid = DeviceGrid(nx=4, ny=4, io_capacity=arch.io_capacity)
+    with pytest.raises(ValueError):
+        build_rr_graph(arch, grid, chan_width=12)
+
+
+@pytest.mark.parametrize("arch,nx,ny,seed", [
+    (unidir_arch(chan_width=6), 4, 4, 0),
+    (_mixed_unidir(), 7, 7, 7),
+    (_mixed_unidir(), 5, 9, 11),
+])
+def test_unidir_planes_relax_matches_ell(arch, nx, ny, seed):
+    """Directed-planes relaxation distances equal the ELL pull-relaxation
+    over the directed CSR on random seeds/congestion/criticalities/boxes
+    (same oracle pattern as the bidir test, on unidir graphs)."""
+    grid = DeviceGrid(nx, ny, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    dev = to_device(rr)
+    pg = build_planes(rr)
+    assert pg.directional
+    N = rr.num_nodes
+    B = 4
+    rng = np.random.default_rng(seed)
+    wires = np.where((rr.node_type == CHANX) | (rr.node_type == CHANY))[0]
+    seed_m = np.zeros((B, N), bool)
+    for b in range(B):
+        seed_m[b, rng.choice(wires, 2, replace=False)] = True
+    cong = rng.uniform(0.5, 2.0, (B, N)).astype(np.float32) * 1e-10
+    crit = rng.uniform(0.0, 0.9, (B, 1)).astype(np.float32)
+    crit[0] = 0.0
+    inside = np.ones((B, N), bool)
+    inside[1] = ((rr.xhigh >= 1) & (rr.xlow <= max(2, nx // 2))
+                 & (rr.yhigh >= 1) & (rr.ylow <= ny))
+    cong_m = np.where(inside, (1 - crit) * cong, np.inf).astype(np.float32)
+
+    dist, _, _, _ = _relax(
+        dev, jnp.asarray(cong_m), jnp.asarray(crit), jnp.asarray(inside),
+        jnp.asarray(seed_m), jnp.zeros((B, N), jnp.float32), 500)
+    dist = np.asarray(dist)
+
+    noc = np.asarray(pg.node_of_cell)
+    d0 = np.where(seed_m[:, noc], 0.0, np.inf).astype(np.float32)
+    dist_flat, pred, _ = planes_relax(
+        pg, jnp.asarray(d0), jnp.asarray(cong_m[:, noc]),
+        jnp.asarray(crit)[:, :, None, None],
+        jnp.zeros((B, pg.ncells), jnp.float32), 64)
+    dist_flat = np.asarray(dist_flat)
+    con = np.asarray(pg.cell_of_node)
+    distp = np.full((B, N), np.inf, np.float32)
+    wmask = con < pg.ncells
+    distp[:, wmask] = dist_flat[:, con[wmask]]
+
+    a, b = dist[:, wires], distp[:, wires]
+    both_inf = np.isinf(a) & np.isinf(b)
+    assert (np.isclose(a, b, rtol=1e-4, atol=1e-13) | both_inf).all()
+
+
+@pytest.mark.parametrize("length", [1, 2])
+def test_unidir_route_legal_deterministic(length):
+    arch = unidir_arch(chan_width=14, length=length)
+    nl = generate_circuit(num_luts=40, num_inputs=6, num_outputs=6,
+                          K=arch.K, seed=3)
+    f = prepare(nl, arch, 14, seed=5)
+    f = run_place(f, timing_driven=False)
+    r1 = Router(f.rr, RouterOpts(batch_size=32)).route(f.term)
+    assert r1.success
+    check_route(f.rr, f.term, r1.paths, occ=r1.occ)
+    r2 = Router(f.rr, RouterOpts(batch_size=32)).route(f.term)
+    assert np.array_equal(r1.paths, r2.paths)
+    # the serial oracle routes the same directed graph
+    rs = SerialRouter(f.rr).route(f.term)
+    assert rs.success
+
+
+def test_unidir_crit_path_parity():
+    """BASELINE bar on a unidir (L=2) graph: device crit path within 1%
+    of the serial oracle on the same placed problem."""
+    arch = unidir_arch(chan_width=16, length=2)
+    nl = array_multiplier(5)
+    f = prepare(nl, arch, 16, seed=7)
+    f = run_place(f)
+    row = qor_compare(f, "mult5_unidir")
+    assert row.cpd_delta_pct <= 1.0, (
+        f"unidir crit path {row.device_cpd:.3e} vs serial "
+        f"{row.serial_cpd:.3e} (+{row.cpd_delta_pct:.2f}%)")
+    assert row.wl_delta_pct <= 15.0
