@@ -239,15 +239,21 @@ class PlanesTerminals:
     SOURCE side: the net's source-class OPINs and every OPIN->wire edge as
     (wire cell, opin index, exact edge delay).  SINK side: every
     (wire -> IPIN -> SINK) two-edge hop as (wire cell, ipin node, exact
-    total delay).  All host numpy; the Router uploads them once per
-    route() call and keeps them device-resident."""
+    total delay) — FACTORIZED by unique sink node: the candidate tables
+    are stored once per distinct SINK rr-node ([U, K], U ~ #blocks) and
+    every (net, sink) slot holds only an int32 index into them.  This
+    removes the [R, S, K] dense term that dominated the Titan-scale
+    memory model (BENCHMARKS.md; the reference's per-node fan-in lists,
+    init.cxx:85, are the same sharing).  All host numpy; the Router
+    uploads them once per route() call and keeps them device-resident."""
     opin_node: np.ndarray       # int32 [R, O] source-class OPINs (pad N)
     entry_cell: np.ndarray      # int32 [R, Ko] wire cell (pad Ncells)
     entry_oidx: np.ndarray      # int32 [R, Ko] index into opin_node (pad 0)
     entry_delay: np.ndarray     # f32  [R, Ko] edge delay OPIN -> wire
-    sink_cell: np.ndarray       # int32 [R, S, K] wire cell (pad Ncells)
-    sink_ipin: np.ndarray       # int32 [R, S, K] IPIN node (pad N)
-    sink_delay: np.ndarray      # f32  [R, S, K] delay wire->IPIN->SINK
+    sink_uid: np.ndarray        # int32 [R, S] unique-sink row (pad U)
+    uid_cell: np.ndarray        # int32 [U+1, K] wire cell (pad Ncells)
+    uid_ipin: np.ndarray        # int32 [U+1, K] IPIN node (pad N)
+    uid_delay: np.ndarray       # f32  [U+1, K] delay wire->IPIN->SINK
 
 
 def _ragged_flat(row_ptr: np.ndarray, nodes: np.ndarray):
@@ -328,23 +334,19 @@ def build_planes_terminals(rr: RRGraph, source: np.ndarray,
     u_of_2 = u_of_1[p_of_2]
     k2, cand_cnt = _within(u_of_2, U)
     K = max(1, int(cand_cnt.max()) if U else 1)
-    u_cell = np.full((U, K), ncells, dtype=np.int32)
-    u_ipin = np.full((U, K), N, dtype=np.int32)
-    u_del = np.zeros((U, K), dtype=np.float32)
+    # one pad row at U: cell=ncells / ipin=N / delay=0 — candidate
+    # extraction on a pad slot sees only INF-distance candidates
+    u_cell = np.full((U + 1, K), ncells, dtype=np.int32)
+    u_ipin = np.full((U + 1, K), N, dtype=np.int32)
+    u_del = np.zeros((U + 1, K), dtype=np.float32)
     u_cell[u_of_2, k2] = cell_of_node[wires2]
     u_ipin[u_of_2, k2] = ipins[p_of_2]
     u_del[u_of_2, k2] = wtot
 
-    sink_cell = np.full((R * S, K), ncells, dtype=np.int32)
-    sink_ipin = np.full((R * S, K), N, dtype=np.int32)
-    sink_delay = np.zeros((R * S, K), dtype=np.float32)
-    sink_cell[valid] = u_cell[inv]
-    sink_ipin[valid] = u_ipin[inv]
-    sink_delay[valid] = u_del[inv]
+    sink_uid = np.full(R * S, U, dtype=np.int32)
+    sink_uid[valid] = inv.astype(np.int32)
     return PlanesTerminals(opin_node, entry_cell, entry_oidx, entry_delay,
-                           sink_cell.reshape(R, S, K),
-                           sink_ipin.reshape(R, S, K),
-                           sink_delay.reshape(R, S, K))
+                           sink_uid.reshape(R, S), u_cell, u_ipin, u_del)
 
 
 
@@ -711,7 +713,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                source_all, sinks_all, crit_all,
                opin_node_all, entry_cell_all, entry_oidx_all,
                entry_delay_all,
-               sink_cell_all, sink_ipin_all, sink_wdelay_all,
+               sink_uid_all, uid_cell, uid_ipin, uid_delay,
                sel, valid, force, full_bb,
                nsweeps: int, max_len: int, num_waves: int, group: int,
                doubling: bool, mesh):
@@ -739,9 +741,10 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
     b_ecell = entry_cell_all[sel]                # [B, Ko]
     b_eoidx = entry_oidx_all[sel]
     b_edelay = entry_delay_all[sel]
-    b_scell = sink_cell_all[sel]                 # [B, S, K]
-    b_sipin = sink_ipin_all[sel]
-    b_swdel = sink_wdelay_all[sel]
+    b_uid = sink_uid_all[sel]                    # [B, S]
+    b_scell = uid_cell[b_uid]                    # [B, S, K]
+    b_sipin = uid_ipin[b_uid]
+    b_swdel = uid_delay[b_uid]
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1004,7 +1007,7 @@ def route_batch_resident_planes(
         paths, sink_delay, all_reached, bb,
         source_all, sinks_all, crit_all,
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
-        sink_cell_all, sink_ipin_all, sink_wdelay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
         sel, valid, full_bb,
         nsweeps: int, max_len: int, num_waves: int, group: int,
         doubling: bool = False, mesh=None):
@@ -1015,7 +1018,7 @@ def route_batch_resident_planes(
         pg, dev, occ, acc, pres_fac, paths, sink_delay, all_reached, bb,
         source_all, sinks_all, crit_all,
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
-        sink_cell_all, sink_ipin_all, sink_wdelay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
         sel, valid, jnp.bool_(True), full_bb,
         nsweeps, max_len, num_waves, group, doubling, mesh)
     return (paths, sink_delay, all_reached, bb, occ,
@@ -1074,7 +1077,7 @@ def route_window_planes(
         paths, sink_delay, all_reached, bb,
         source_all, sinks_all, crit_all,
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
-        sink_cell_all, sink_ipin_all, sink_wdelay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
         sel_plan, valid_plan, full_bb,
         pres0, pres_mult, max_pres, acc_fac, it0, force_until,
         K_iters: int, nsweeps: int, max_len: int, num_waves: int,
@@ -1126,7 +1129,7 @@ def route_window_planes(
                     source_all, sinks_all, crit_all,
                     opin_node_all, entry_cell_all, entry_oidx_all,
                     entry_delay_all,
-                    sink_cell_all, sink_ipin_all, sink_wdelay_all,
+                    sink_uid_all, uid_cell, uid_ipin, uid_delay,
                     sel_plan[g], valid_plan[g], force, full_bb,
                     nsweeps, max_len, num_waves, group, doubling, mesh)
                 return (occ2, paths2, sink_delay2, all_reached2, bb2,
@@ -1175,7 +1178,12 @@ def route_window_planes(
     rrm, colors = _mis_colors(dev, occ, paths, all_reached,
                               topk, n_colors)
     over = jnp.maximum(occ - dev.capacity, 0)
+    # max bb half-perimeter of a still-dirty net: the host compares it
+    # against the current path-slot budget and regrows the (bb-adaptive)
+    # paths array when a device-side widening outgrew it
+    span = (bb[:, 1] - bb[:, 0]) + (bb[:, 3] - bb[:, 2])
+    max_span = jnp.max(jnp.where(rrm, span, 0))
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
             colors, (over > 0).sum(dtype=jnp.int32),
             over.sum(dtype=jnp.int32), nroutes, nexec, crit_all,
-            dmax_hist)
+            dmax_hist, max_span)

@@ -244,6 +244,19 @@ def _pow2_at_least(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
 
 
+def path_budget(span: int, cap: int) -> int:
+    """Path-slot budget for a bb half-perimeter `span`: ~2x the span plus
+    winding slack, bucketed to 64 to bound compile variants, capped at
+    the device budget.  THE single definition — the allocator, both
+    regrowth sites, and scale_bench's memory model all use it."""
+    return min(cap, ((2 * span + 64 + 63) // 64) * 64)
+
+
+def _grow_paths(paths, L_new: int, N: int):
+    return jnp.pad(paths, ((0, 0), (0, 0), (0, L_new - paths.shape[2])),
+                   constant_values=N)
+
+
 class Router:
     """Holds device state across a route() call; reusable across calls
     (e.g. the placer's delay-lookup routing, timing_place_lookup.c:981).
@@ -419,6 +432,9 @@ class Router:
         full_reroute_done = False
         force_all_next = False
 
+        L = int(paths.shape[2])          # current path-slot budget
+        L_cap = self.max_len
+
         widx = 0
         while it_done < opts.max_router_iterations:
             K = self._WINDOWS[min(widx, len(self._WINDOWS) - 1)]
@@ -464,16 +480,18 @@ class Router:
                 jnp.float32(opts.acc_fac), jnp.int32(it_done),
                 jnp.int32(it_done + 1 if force_all_next
                           else opts.incremental_after),
-                K, nsweeps, self.max_len, waves, grp_w,
+                K, nsweeps, L, waves, grp_w,
                 doubling, min(4096, N), 5, self.mesh, **sta_kw)
             occ, acc, paths, sink_delay, all_reached, bb = out[:6]
             force_all_next = False
             # the ONE sync per window (dmax_hist rides along: the
-            # per-iteration crit-path delays from the fused STA)
-            rrm, colors, n_over, over_total, nroutes, nexec, dmax_hist = (
+            # per-iteration crit-path delays from the fused STA;
+            # max_span: largest dirty-net bb for path-budget regrowth)
+            (rrm, colors, n_over, over_total, nroutes, nexec, dmax_hist,
+             max_span) = (
                 np.asarray(v) for v in jax.device_get(
                     (out[7], out[8], out[9], out[10], out[11],
-                     out[12], out[14])))
+                     out[12], out[14], out[15])))
             crit_d = out[13]            # donated in; stays device-resident
             n_over, over_total = int(n_over), int(over_total)
             it_done += K
@@ -503,6 +521,19 @@ class Router:
                 result.iterations = it_done
                 break
 
+            # path-budget regrowth: device-side widening (unreached
+            # sinks get full-device boxes inside _step_core) can outgrow
+            # the bb-adaptive L; pad the store and recompile (rare).  A
+            # net on a full-device box gets the FULL budget — a
+            # congested detour can wind well past 2x the half-perimeter
+            if int(max_span) >= rr.grid.nx + rr.grid.ny:
+                L_need = L_cap
+            else:
+                L_need = path_budget(int(max_span), L_cap)
+            if L_need > L:
+                paths = _grow_paths(paths, L_need, N)
+                L = L_need
+
             # plateau valve at window granularity (…cxx:6238-6267)
             if n_over < best_over:
                 best_over = n_over
@@ -520,6 +551,9 @@ class Router:
                     result.widened_nets += int(stuck.sum())
                     bb = jnp.where(jnp.asarray(stuck)[:, None],
                                    full_bb[None, :], bb)
+                    if L < L_cap:    # full-device boxes need full budget
+                        paths = _grow_paths(paths, L_cap, N)
+                        L = L_cap
                 stall_windows = 0
 
             dirty = np.where(rrm)[0]
@@ -596,7 +630,20 @@ class Router:
         # "device-resident stepping")
         occ = self._put_node(jnp.zeros(N, dtype=jnp.int32))
         acc = self._put_node(jnp.ones(N, dtype=jnp.float32))
-        paths = jnp.full((R, Smax, self.max_len), N, dtype=jnp.int32)
+        # bb-adaptive path-slot budget: a bb-confined path needs ~2x the
+        # box half-perimeter, not the device half-perimeter — the dense
+        # [R, Smax, L] store's L term shrinks to the circuit's largest
+        # box (the Titan-scale memory fix, BENCHMARKS.md memory model).
+        # Bucketed to 64 to bound compile variants; regrown on demand
+        # when negotiation widens boxes past the budget (rare event,
+        # host-side pad + recompile).
+        if R:
+            span0 = int(((term.bb_xmax - term.bb_xmin)
+                         + (term.bb_ymax - term.bb_ymin)).max())
+        else:
+            span0 = 8
+        L = path_budget(span0, self.max_len)
+        paths = jnp.full((R, Smax, L), N, dtype=jnp.int32)
         sink_delay = jnp.full((R, Smax), jnp.inf, dtype=jnp.float32)
         all_reached = jnp.zeros(R, dtype=bool)
         bb = jnp.asarray(np.stack(
@@ -629,8 +676,8 @@ class Router:
                     np.asarray(self.pg.cell_of_node), self.pg.ncells)
                 self._pt = tuple(jnp.asarray(a) for a in (
                     pt.opin_node, pt.entry_cell, pt.entry_oidx,
-                    pt.entry_delay, pt.sink_cell, pt.sink_ipin,
-                    pt.sink_delay))
+                    pt.entry_delay, pt.sink_uid, pt.uid_cell,
+                    pt.uid_ipin, pt.uid_delay))
                 self._pt_key = id(term)
                 self._pt_ref = term          # keep id(term) alive
             planes_tbl = self._pt
@@ -678,6 +725,8 @@ class Router:
             result.windowed_nets = int((~wide).sum())
         n_over = -1                      # previous iteration's overuse
         crit_d = None                    # uploaded once; refreshed on cb
+        L_e = int(paths.shape[2])        # bb-adaptive path budget
+        L_cap = self.max_len
         stall = 0                        # phase-two plateau counter
         best_over = 1 << 30              # best overuse seen so far
         rrm = np.ones(R, dtype=bool)     # reroute mask from last summary
@@ -751,14 +800,14 @@ class Router:
                         paths, sink_delay, all_reached,
                         source_d, sinks_d, crit_d, sel_d, selw_d,
                         valid_d, lb_scale,
-                        self.max_len, self.max_len, waves, grp, self.mesh)
+                        self.max_len, L_e, waves, grp, self.mesh)
                 else:
                     (paths, sink_delay, all_reached, bb, occ,
                      steps) = route_batch_resident(
                         dev, occ, acc, jnp.float32(pres_fac),
                         paths, sink_delay, all_reached, bb,
                         source_d, sinks_d, crit_d, sel_d, valid_d, full_bb,
-                        self.max_len, self.max_len, waves, grp, self.mesh)
+                        self.max_len, L_e, waves, grp, self.mesh)
                 steps_dev = steps_dev + steps
                 result.total_net_routes += nsel
 
@@ -776,6 +825,12 @@ class Router:
             # a net that failed a sink gets the full device next time
             # (place_and_route.c bb relaxation); it leaves the windowed
             # program for good — its window no longer matches its bb
+            # ANY unreached sink (including born-wide nets, whose wide
+            # flag predates this iteration) means a full-device search
+            # comes next: give the path store the full budget
+            if (~ar).any() and L_e < L_cap:
+                paths = _grow_paths(paths, L_cap, N)
+                L_e = L_cap
             newly_wide = ~ar & ~wide
             if newly_wide.any():
                 wide |= newly_wide
@@ -805,6 +860,9 @@ class Router:
                     result.widened_nets += int(stuck.sum())
                     bb = jnp.where(jnp.asarray(stuck)[:, None],
                                    full_bb[None, :], bb)
+                    if L_e < L_cap:
+                        paths = _grow_paths(paths, L_cap, N)
+                        L_e = L_cap
                 stall = 0
             result.total_relax_steps += it_steps
             result.stats.append(RouteStats(

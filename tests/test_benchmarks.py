@@ -16,6 +16,11 @@ from parallel_eda_tpu.route.check import check_route_trees
 from parallel_eda_tpu.route.serial_ref import SerialRouter
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def test_synthesis_netlists_wellformed():
     m = array_multiplier(6)
     assert m.num_luts > 50

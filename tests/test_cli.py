@@ -15,6 +15,9 @@ from parallel_eda_tpu.netlist.files import (read_place_file,
 from parallel_eda_tpu.route import RouterOpts
 
 
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def test_cli_full_flow(tmp_path):
     rc = main(["--luts", "25", "--arch", "minimal",
                "--route_chan_width", "12", "--batch_size", "16",

@@ -7,6 +7,11 @@ from parallel_eda_tpu.place import PlacerOpts
 from parallel_eda_tpu.route import RouterOpts
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def test_full_flow_place_route_sta():
     f = synth_flow(num_luts=25, chan_width=12, seed=1)
     f = run_place(f, PlacerOpts(moves_per_step=32, seed=1))

@@ -13,6 +13,11 @@ from parallel_eda_tpu.netlist.synthesis import ram_pipeline
 from parallel_eda_tpu.place.sa import PlacerOpts
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def _arch():
     # small RAM blocks so the test grid stays tiny
     return k6_n10_mem_arch(addr_bits=4, data_bits=4, mem_start=3,

@@ -13,6 +13,11 @@ from parallel_eda_tpu.place.macros import form_macros
 from parallel_eda_tpu.place import PlacerOpts
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def _macro_aligned(pos, macros):
     for m in macros:
         xs = pos[m, 0]

@@ -14,6 +14,9 @@ from parallel_eda_tpu.route.device_graph import to_device
 from parallel_eda_tpu.route.search import route_and_commit
 
 
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def _setup(B=8):
     f = synth_flow(num_luts=25, chan_width=12, seed=2)
     rr, term = f.rr, f.term

@@ -8,6 +8,9 @@ from parallel_eda_tpu.flow import synth_flow
 from parallel_eda_tpu.place import Placer, PlacerOpts
 
 
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def _problem(num_luts=40, seed=1):
     f = synth_flow(num_luts=num_luts, num_inputs=4, num_outputs=4,
                    chan_width=12, seed=seed)

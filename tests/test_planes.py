@@ -32,6 +32,7 @@ def _mixed_len_arch():
     return arch
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("arch,nx,ny,seed", [
     (minimal_arch(chan_width=6), 4, 4, 0),
     (_mixed_len_arch(), 7, 7, 7),
@@ -107,6 +108,7 @@ def test_planes_route_legal_and_deterministic():
     assert np.array_equal(r1.occ, r2.occ)
 
 
+@pytest.mark.slow
 def test_planes_vs_ell_quality():
     """The two programs implement the same cost model; their negotiated
     wirelengths must land in the same quality class (not bit-equal: the
@@ -121,6 +123,7 @@ def test_planes_vs_ell_quality():
     assert rp.wirelength <= re.wirelength * 1.15 + 5
 
 
+@pytest.mark.slow
 def test_planes_incremental_sink_schedule():
     """sink_group=1 (exact VPR incremental) must also route legally via
     the planes program, with wirelength no worse than the default

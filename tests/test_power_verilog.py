@@ -17,6 +17,9 @@ from parallel_eda_tpu.netlist.verilog import (lut_mask,
 from parallel_eda_tpu.power import PowerOpts, activities, estimate_power
 
 
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 @pytest.fixture(scope="module")
 def routed_flow():
     f = synth_flow(num_luts=30, num_inputs=6, num_outputs=6,

@@ -11,6 +11,11 @@ from parallel_eda_tpu.route.qor import qor_compare
 from parallel_eda_tpu.arch.builtin import minimal_arch
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def test_crit_path_parity_mult6():
     nl = array_multiplier(6)
     f = prepare(nl, minimal_arch(chan_width=14), chan_width=14, seed=7)

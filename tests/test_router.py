@@ -9,6 +9,9 @@ from parallel_eda_tpu.flow import synth_flow
 from parallel_eda_tpu.route import Router, RouterOpts, check_route
 
 
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def _flow(num_luts=30, chan_width=12, seed=1, arch=None, bb_factor=3):
     f = synth_flow(num_luts=num_luts, num_inputs=4, num_outputs=4,
                    chan_width=chan_width, seed=seed, arch=arch,

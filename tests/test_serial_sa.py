@@ -9,6 +9,11 @@ from parallel_eda_tpu.place.sa import build_place_problem, net_bb_cost
 from parallel_eda_tpu.place.serial_sa import serial_sa_place
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def test_serial_sa_improves_and_matches_oracle():
     flow = synth_flow(num_luts=60, num_inputs=8, num_outputs=8,
                       chan_width=12, seed=5)

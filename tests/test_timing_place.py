@@ -9,6 +9,11 @@ from parallel_eda_tpu.place import PlacerOpts, compute_delay_lookup
 from parallel_eda_tpu.route import RouterOpts
 
 
+import pytest
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 def test_delay_lookup_monotone():
     f = synth_flow(num_luts=25, chan_width=12, seed=3)
     lk = compute_delay_lookup(f.rr)

@@ -79,6 +79,7 @@ def test_unidir_mixed_directionality_rejected():
         build_rr_graph(arch, grid, chan_width=12)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("arch,nx,ny,seed", [
     (unidir_arch(chan_width=6), 4, 4, 0),
     (_mixed_unidir(), 7, 7, 7),
@@ -130,6 +131,7 @@ def test_unidir_planes_relax_matches_ell(arch, nx, ny, seed):
     assert (np.isclose(a, b, rtol=1e-4, atol=1e-13) | both_inf).all()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("length", [1, 2])
 def test_unidir_route_legal_deterministic(length):
     arch = unidir_arch(chan_width=14, length=length)
@@ -147,6 +149,7 @@ def test_unidir_route_legal_deterministic(length):
     assert rs.success
 
 
+@pytest.mark.slow
 def test_unidir_crit_path_parity():
     """BASELINE bar on a unidir (L=2) graph: device crit path within 1%
     of the serial oracle on the same placed problem."""

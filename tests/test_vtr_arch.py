@@ -13,6 +13,9 @@ import pytest
 
 from parallel_eda_tpu.arch.xml_parser import read_arch_xml
 
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
 FIX = os.path.join(os.path.dirname(__file__), "golden",
                    "k6_frac_n10_mem.xml")
 

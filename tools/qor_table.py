@@ -1,0 +1,86 @@
+"""QoR table runner: crit-path/wirelength parity rows for BENCHMARKS.md.
+
+Runs the full timing-driven flow on the device router AND the serial
+oracle (route/qor.py) for each named circuit and appends JSON rows to
+qor_rows.jsonl (resumable; rows are independent).
+
+Usage:  python tools/qor_table.py [row ...]
+Rows: mult6 mult8 mult10 crc16 synth300 hetero unidir_mult6
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def build(row: str):
+    from parallel_eda_tpu.arch.builtin import (k6_n10_mem_arch, minimal_arch,
+                                               unidir_arch)
+    from parallel_eda_tpu.flow import prepare, run_place, synth_flow
+    from parallel_eda_tpu.netlist.synthesis import (array_multiplier,
+                                                    crc_xor_tree,
+                                                    ram_pipeline)
+
+    if row.startswith("mult"):
+        n = int(row[4:])
+        w = {6: 14, 8: 16, 10: 20}.get(n, 20)
+        f = prepare(array_multiplier(n), minimal_arch(chan_width=w), w,
+                    seed=7)
+    elif row == "crc16":
+        f = prepare(crc_xor_tree(16, 16, K=4), minimal_arch(chan_width=16),
+                    16, seed=7)
+    elif row == "hetero":
+        from parallel_eda_tpu.arch.builtin import k6_n10_mem_arch
+        f = prepare(ram_pipeline(n_mems=2, addr_bits=4, data_bits=4),
+                    k6_n10_mem_arch(addr_bits=4, data_bits=4), 24, seed=7)
+    elif row.startswith("synth"):
+        n = int(row[5:])
+        f = synth_flow(num_luts=n, num_inputs=16, num_outputs=16,
+                       chan_width=16, seed=7)
+    elif row == "unidir_mult6":
+        f = prepare(array_multiplier(6), unidir_arch(chan_width=16), 16,
+                    seed=7)
+    else:
+        raise SystemExit(f"unknown row {row}")
+    return run_place(f)
+
+
+def main():
+    from parallel_eda_tpu.route.qor import qor_compare
+
+    rows = sys.argv[1:] or ["mult6", "mult8", "mult10", "crc16",
+                            "hetero", "unidir_mult6"]
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "qor_rows.jsonl")
+    for row in rows:
+        t0 = time.time()
+        try:
+            f = build(row)
+            q = qor_compare(f, row)
+            rec = {"row": row, "device_cpd_ns": q.device_cpd * 1e9,
+                   "serial_cpd_ns": q.serial_cpd * 1e9,
+                   "cpd_delta_pct": q.cpd_delta_pct,
+                   "device_wl": q.device_wl, "serial_wl": q.serial_wl,
+                   "wl_delta_pct": q.wl_delta_pct,
+                   "device_iters": q.device_iters,
+                   "device_windows": q.device_windows,
+                   "serial_iters": q.serial_iters,
+                   "wall_s": round(time.time() - t0, 1)}
+        except Exception as e:
+            rec = {"row": row, "error": f"{type(e).__name__}: {e}",
+                   "wall_s": round(time.time() - t0, 1)}
+        print(json.dumps(rec), flush=True)
+        with open(out, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()
