@@ -160,6 +160,9 @@ def main():
                          "time as a LOWER BOUND, vs_baseline marked >=")
     ap.add_argument("--skip_serial", action="store_true",
                     help="report device throughput only (vs_baseline 0)")
+    ap.add_argument("--py_serial", action="store_true",
+                    help="force the pure-Python serial baseline "
+                         "(default: the bit-identical native C++ one)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (smoke tests; the "
                          "sitecustomize would otherwise dial the tunneled "
@@ -212,8 +215,30 @@ def main():
         serial_nets_per_sec = 0.0
         sres = None
         sdt = 0.0
+        native = None
+        ndt = 0.0
     else:
         from parallel_eda_tpu.route.serial_ref import SerialRouter
+
+        # the stretch bar: the native C++ serial router (bit-identical
+        # algorithm, serial-VPR speed class).  Cheap, so always run it;
+        # reported in detail.native_* with vs_native
+        native = None
+        ndt = 0.0
+        if not args.py_serial:
+            try:
+                from parallel_eda_tpu.route.serial_native import (
+                    NativeSerialRouter, native_available)
+                if native_available():
+                    t0 = time.time()
+                    native = NativeSerialRouter(rr).route(
+                        term, deadline_s=args.serial_timeout or None)
+                    ndt = time.time() - t0
+                    log(f"native serial route: {ndt:.3f}s, "
+                        f"success={native.success}, "
+                        f"wirelength {native.wirelength}")
+            except Exception as e:
+                log(f"native serial baseline failed: {e}")
 
         t0 = time.time()
         try:
@@ -275,7 +300,15 @@ def main():
             "vs_baseline_semantics": (
                 "wall_clock_speedup" if wall_semantics
                 else "nets_per_sec"),
-            "baseline": "serial_ref heap PathFinder (serial-VPR stand-in)",
+            "baseline": "serial_ref heap PathFinder (serial-VPR "
+                        "stand-in; native C++ stretch bar in native_*)",
+            # the stretch bar: bit-identical C++ serial router
+            "native_route_time_s": round(ndt, 4) if native else None,
+            "native_success": bool(native.success) if native else None,
+            "native_wirelength": (int(native.wirelength) if native
+                                  else None),
+            "vs_native_wall": (round(ndt / max(dt, 1e-9), 5)
+                               if native else None),
         },
     }))
 

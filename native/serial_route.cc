@@ -1,0 +1,241 @@
+// Native serial PathFinder — the honest serial-CPU routing baseline.
+//
+// C++ implementation of the exact algorithm of route/serial_ref.py
+// (which mirrors vpr/SRC/route/route_timing.c:85 try_timing_driven_route:
+// per-net rip-up, per-sink A* grown from the partial route tree,
+// present/history cost update per iteration).  The Python serial_ref is
+// the ALGORITHMIC oracle; this is the SPEED-CLASS baseline — stock VPR
+// is C++, so a Python baseline understates the bar (BASELINE.md requires
+// wall-clock speedup vs serial CPU VPR).  Operation order and tie-breaks
+// match serial_ref bit-for-bit (double arithmetic, heap ties broken by
+// node id), so the cross-check test asserts identical route trees.
+//
+// Interface: one C function, flat arrays, built with g++ -O3 -shared
+// (see route/serial_native.py).
+
+#include <cstdint>
+#include <chrono>
+#include <cmath>
+#include <cstring>
+#include <queue>
+#include <vector>
+#include <algorithm>
+
+extern "C" {
+
+// returns: 1 routed, 0 not routed (max iterations), -1 tree buffer too
+// small, -2 unreachable sink
+int64_t serial_route(
+    // graph
+    int64_t N, const int32_t* row_ptr, const int32_t* dst,
+    const double* edge_delay,          // [E] switch Tdel + C load
+    const double* base,                // [N] base_cost * delay_norm
+    const int32_t* cap,                // [N]
+    const int32_t* xlow, const int32_t* xhigh,
+    const int32_t* ylow, const int32_t* yhigh,
+    const uint8_t* is_wire,            // [N]
+    int64_t nx, int64_t ny,
+    // nets
+    int64_t R, int64_t Smax,
+    const int32_t* source,             // [R]
+    const int32_t* num_sinks,          // [R]
+    const int32_t* sinks,              // [R*Smax]
+    int32_t* bbs,                      // [R*4] xlo,xhi,ylo,yhi (mutated)
+    const float* crit,                 // [R*Smax] or nullptr
+    // params
+    int64_t max_iterations, double initial_pres_fac, double pres_fac_mult,
+    double acc_fac, double max_pres_fac, double astar_fac,
+    double min_wire_cost, double deadline_s,
+    // outputs
+    int32_t* occ_out,                  // [N]
+    int64_t* iters_out, int64_t* pops_out, int64_t* wirelen_out,
+    int64_t* reroutes_out, int64_t* timed_out_out,
+    // flattened trees: pairs (node, parent) per net, net r occupying
+    // tree_off[r] .. tree_off[r+1] pairs
+    int32_t* tree_flat, int64_t tree_cap, int64_t* tree_off) {
+
+  std::vector<int64_t> occ(N, 0);
+  std::vector<double> acc(N, 1.0);
+  // per-net trees as (node -> parent) insertion-ordered vectors + a
+  // membership stamp array (tree sizes are tiny vs N)
+  std::vector<std::vector<std::pair<int32_t, int32_t>>> trees(R);
+  // membership stamp: one generation per _route_net call, so a net's
+  // previous routing never aliases its re-route
+  std::vector<int64_t> in_tree_stamp(N, -1);
+  int64_t gen = 0;
+
+  std::vector<double> dist(N);
+  std::vector<int32_t> prev(N);
+  double pres_fac = initial_pres_fac;
+  int64_t pops = 0, reroutes = 0;
+  int64_t it = 0;
+  bool success = false, timed_out = false;
+  auto t_start = std::chrono::steady_clock::now();
+  auto elapsed = [&]() {
+    return std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - t_start).count();
+  };
+
+  // congestion cost of entering node u for the current net view
+  // (occ excludes the net: caller ripped it up) — serial_ref computes
+  // over = occ + 1 - cap; pres = over > 0 ? 1 + over*pres_fac : 1
+  auto cong = [&](int64_t u) -> double {
+    int64_t over = occ[u] + 1 - cap[u];
+    double pres = over > 0 ? 1.0 + (double)over * pres_fac : 1.0;
+    return base[u] * pres * acc[u];
+  };
+
+  typedef std::pair<double, int64_t> QE;
+
+  std::vector<int32_t> reroute;
+  reroute.reserve(R);
+
+  for (it = 1; it <= max_iterations; ++it) {
+    reroute.clear();
+    if (it == 1) {
+      for (int64_t i = 0; i < R; ++i) reroute.push_back((int32_t)i);
+    } else {
+      for (int64_t i = 0; i < R; ++i) {
+        bool dirty = false;
+        for (auto& nv : trees[i])
+          if (occ[nv.first] > cap[nv.first]) { dirty = true; break; }
+        if (dirty) reroute.push_back((int32_t)i);
+      }
+    }
+    for (int32_t i : reroute) {
+      if (deadline_s > 0 && elapsed() > deadline_s) {
+        timed_out = true;
+        break;
+      }
+      // rip up
+      for (auto& nv : trees[i]) occ[nv.first] -= 1;
+      // ---- route net i (serial_ref._route_net) ----
+      int64_t src = source[i];
+      int64_t ns = num_sinks[i];
+      int32_t* bb = bbs + 4 * i;
+      // sink order: most critical first, then nearest to source
+      std::vector<int64_t> order(ns);
+      for (int64_t s = 0; s < ns; ++s) order[s] = s;
+      std::stable_sort(order.begin(), order.end(),
+        [&](int64_t a, int64_t b) {
+          float ca = crit ? crit[i * Smax + a] : 0.0f;
+          float cb = crit ? crit[i * Smax + b] : 0.0f;
+          if (ca != cb) return ca > cb;
+          int64_t sa = sinks[i * Smax + a], sb = sinks[i * Smax + b];
+          int64_t da = std::abs((int64_t)xlow[sa] - xlow[src])
+                     + std::abs((int64_t)ylow[sa] - ylow[src]);
+          int64_t db = std::abs((int64_t)xlow[sb] - xlow[src])
+                     + std::abs((int64_t)ylow[sb] - ylow[src]);
+          return da < db;
+        });
+      // fresh tree
+      auto& tree = trees[i];
+      tree.clear();
+      tree.push_back({(int32_t)src, -1});
+      ++gen;
+      in_tree_stamp[src] = gen;
+      int64_t k = 0;
+      while (k < ns) {
+        int64_t target = sinks[i * Smax + order[k]];
+        double cw = crit ? (double)crit[i * Smax + order[k]] : 0.0;
+        int64_t tx = xlow[target], ty = ylow[target];
+        std::fill(dist.begin(), dist.end(),
+                  std::numeric_limits<double>::infinity());
+        std::fill(prev.begin(), prev.end(), -1);
+        std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
+        for (auto& nv : tree) {
+          int64_t v = nv.first;
+          dist[v] = 0.0;
+          double h = (double)(std::abs((int64_t)xlow[v] - tx)
+                            + std::abs((int64_t)ylow[v] - ty))
+                     * min_wire_cost * astar_fac * (1.0 - cw);
+          heap.push({h, v});
+        }
+        bool found = false;
+        while (!heap.empty()) {
+          QE top = heap.top(); heap.pop();
+          int64_t v = top.second;
+          ++pops;
+          if (v == target) { found = true; break; }
+          double dv = dist[v];
+          for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
+            int64_t u = dst[e];
+            if (!(bb[0] <= xlow[u] && xhigh[u] <= bb[1]
+                  && bb[2] <= ylow[u] && yhigh[u] <= bb[3]))
+              continue;
+            double nd = dv + cw * edge_delay[e] + (1.0 - cw) * cong(u);
+            if (nd < dist[u]) {
+              dist[u] = nd;
+              prev[u] = (int32_t)v;
+              double h = (double)(std::abs((int64_t)xlow[u] - tx)
+                                + std::abs((int64_t)ylow[u] - ty))
+                         * min_wire_cost * astar_fac * (1.0 - cw);
+              heap.push({nd + h, u});
+            }
+          }
+        }
+        if (!found) {
+          if (bb[0] != 0 || bb[1] != nx + 1 || bb[2] != 0
+              || bb[3] != ny + 1) {
+            bb[0] = 0; bb[1] = (int32_t)(nx + 1);
+            bb[2] = 0; bb[3] = (int32_t)(ny + 1);
+            continue;                 // retry this sink, full device
+          }
+          return -2;                  // unreachable even on full device
+        }
+        // backtrack into the tree
+        int64_t v = target;
+        // collect path segment (reverse order like the Python dict
+        // insertion: target first)
+        while (in_tree_stamp[v] != gen) {
+          tree.push_back({(int32_t)v, prev[v]});
+          in_tree_stamp[v] = gen;
+          v = prev[v];
+        }
+        ++k;
+      }
+      // ---- end route net ----
+      for (auto& nv : tree) occ[nv.first] += 1;
+      ++reroutes;
+    }
+    if (timed_out) break;
+    bool over = false;
+    for (int64_t v = 0; v < N && !over; ++v)
+      if (occ[v] > cap[v]) over = true;
+    if (!over) { success = true; break; }
+    for (int64_t v = 0; v < N; ++v)
+      if (occ[v] > cap[v]) acc[v] += acc_fac * (double)(occ[v] - cap[v]);
+    pres_fac = std::min(max_pres_fac, pres_fac * pres_fac_mult);
+  }
+  if (it > max_iterations) it = max_iterations;
+
+  // outputs
+  for (int64_t v = 0; v < N; ++v) occ_out[v] = (int32_t)occ[v];
+  int64_t wl = 0;
+  {
+    std::vector<uint8_t> used(N, 0);
+    for (int64_t i = 0; i < R; ++i)
+      for (auto& nv : trees[i]) used[nv.first] = 1;
+    for (int64_t v = 0; v < N; ++v)
+      if (used[v] && is_wire[v]) ++wl;
+  }
+  *iters_out = it;
+  *timed_out_out = timed_out ? 1 : 0;
+  *pops_out = pops;
+  *wirelen_out = wl;
+  *reroutes_out = reroutes;
+  int64_t off = 0;
+  for (int64_t i = 0; i < R; ++i) {
+    tree_off[i] = off;
+    if (off + (int64_t)trees[i].size() > tree_cap / 2) return -1;
+    for (auto& nv : trees[i]) {
+      tree_flat[2 * off] = nv.first;
+      tree_flat[2 * off + 1] = nv.second;
+      ++off;
+    }
+  }
+  tree_off[R] = off;
+  return success ? 1 : 0;
+}
+
+}  // extern "C"

@@ -97,14 +97,22 @@ def qor_compare(flow, name: str = "circuit",
     ta_d.analyze(res_d.sink_delay)
     cpd_d = float(ta_d.crit_path_delay)
 
-    # --- serial: analyze -> crit -> reroute passes ---
+    # --- serial: analyze -> crit -> reroute passes (the native C++
+    # router when available — bit-identical to serial_ref, ~30x faster;
+    # tests/test_serial_native.py enforces the equivalence) ---
+    try:
+        from .serial_native import NativeSerialRouter, native_available
+        serial_cls = (NativeSerialRouter if native_available()
+                      else SerialRouter)
+    except Exception:
+        serial_cls = SerialRouter
     ta_s = TimingAnalyzer(tg)
     crit = None
     cpd_s = np.inf
     res_s = None
     iters_s = 0
     for _ in range(timing_passes):
-        sr = SerialRouter(rr)
+        sr = serial_cls(rr)
         r = sr.route(term, crit=crit)
         assert r.success, "serial route failed"
         sd = serial_sink_delays(rr, term, r.trees)

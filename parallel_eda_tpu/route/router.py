@@ -98,6 +98,10 @@ class RouterOpts:
     # stats_dir (…cxx:6167 diagnostics; pulls paths off-device each
     # iteration, debug only)
     dump_routes: bool = False
+    # snapshot the full negotiation state every >= this many iterations
+    # (at window boundaries) into result.checkpoint — the elastic
+    # resume surface (RouteCheckpoint; planes program only).  0 = off
+    checkpoint_every: int = 0
 
 
 @dataclass
@@ -117,6 +121,29 @@ class RouteStats:
 
 
 @dataclass
+class RouteCheckpoint:
+    """Host snapshot of the COMPLETE negotiation state at a window
+    boundary — the checkpoint/resume + elastic-recovery surface (SURVEY
+    §5.3/§5.4).  The reference's closest mechanism is the MPI router's
+    communicator halving (mpi_route_load_balanced_nonblocking_send_recv_
+    encoded.cxx:1560-1680), which re-partitions live route state onto
+    fewer ranks when progress stalls; here the state is fetched once and
+    can be re-uploaded under ANY mesh layout — resume the same
+    negotiation on a smaller mesh (device loss), a bigger one, or a
+    single chip, deterministically."""
+    occ: np.ndarray
+    acc: np.ndarray
+    paths: np.ndarray
+    sink_delay: np.ndarray
+    all_reached: np.ndarray
+    bb: np.ndarray
+    crit: np.ndarray
+    it_done: int
+    pres: float
+    driver: dict                  # host scheduling state (widx, wide, ...)
+
+
+@dataclass
 class RouteResult:
     success: bool
     iterations: int
@@ -133,6 +160,8 @@ class RouteResult:
     widened_nets: int = 0
     # nets the windowed program handled at the start (0 = windows off)
     windowed_nets: int = 0
+    # latest window-boundary state snapshot (opts.checkpoint_every > 0)
+    checkpoint: Optional["RouteCheckpoint"] = None
 
 
 def _color_schedule(idx: np.ndarray, conflict: np.ndarray):
@@ -374,7 +403,7 @@ class Router:
                               occ, acc,
                               paths, sink_delay, all_reached, bb, full_bb,
                               source_d, sinks_d, planes_tbl, nsinks_np,
-                              cx_np, cy_np, result, B):
+                              cx_np, cy_np, result, B, resume=None):
         """Window-fused PathFinder driver for the planes program: the
         negotiation runs as a sequence of multi-iteration device programs
         (planes.route_window_planes) with ONE host sync per window — the
@@ -431,11 +460,39 @@ class Router:
         precise = opts.sink_group != 0
         full_reroute_done = False
         force_all_next = False
+        widx = 0
+
+        if resume is not None:
+            # elastic resume: the checkpointed negotiation continues
+            # under THIS router's mesh layout (occ/acc etc. were already
+            # re-uploaded by route()); restore the host scheduling state
+            pres = resume.pres
+            it_done = resume.it_done
+            d = resume.driver
+            widx = d["widx"]
+            dirty = d["dirty"].copy()
+            colors = (d["colors"].copy()
+                      if d["colors"] is not None else None)
+            wide = d["wide"].copy()
+            bb_full = d["bb_full"].copy()
+            best_over = d["best_over"]
+            stall_windows = d["stall_windows"]
+            sweep_boost = d["sweep_boost"]
+            precise = d["precise"]
+            full_reroute_done = d["full_reroute_done"]
+            force_all_next = d["force_all_next"]
+            result.widened_nets = d["widened_nets"]
 
         L = int(paths.shape[2])          # current path-slot budget
         L_cap = self.max_len
-
-        widx = 0
+        next_ckpt = (it_done + opts.checkpoint_every
+                     if opts.checkpoint_every else None)
+        # structured per-(window, category) logging (zlog/MDC
+        # equivalent, parallel_route/log.cxx:40-68): no-op unless a
+        # stats_dir sink is configured, like the reference's
+        # compiled-out log macros
+        from ..mdclog import MdcLogger
+        mlog = MdcLogger(opts.stats_dir)
         while it_done < opts.max_router_iterations:
             K = self._WINDOWS[min(widx, len(self._WINDOWS) - 1)]
             if (timing_cb is not None and analyzer is None) \
@@ -510,6 +567,25 @@ class Router:
                 crit_path_delay=cpd))
             if analyzer is not None and cpd == cpd:
                 analyzer.crit_path_delay = cpd
+            if mlog.enabled:
+                mlog.set_mdc(widx)
+                mlog.log("route", iteration=it_done, K=K,
+                         rerouted=len(dirty), groups=int(nexec),
+                         relax_steps=w_steps)
+                mlog.log("congestion", overused_nodes=n_over,
+                         overuse_total=over_total,
+                         pres_fac=round(pres, 4),
+                         widened=result.widened_nets)
+                mlog.log("schedule",
+                         colors=int(np.max(colors) + 1
+                                    if colors is not None
+                                    and len(colors) else 0),
+                         dirty_next=int(rrm.sum()),
+                         precise=precise, sweep_boost=sweep_boost)
+                if cpd == cpd:
+                    mlog.log("timing", crit_path_delay=cpd,
+                             dmax_hist=[None if d != d else float(d)
+                                        for d in dmax_hist.tolist()])
             pres = min(opts.max_pres_fac,
                        pres * opts.pres_fac_mult ** K)
             if opts.stats_dir and opts.dump_routes:
@@ -575,9 +651,35 @@ class Router:
                 crit = np.minimum(np.asarray(
                     timing_cb(result), dtype=np.float32), 0.99)
                 crit_d = jnp.asarray(crit)
+
+            if next_ckpt is not None and it_done >= next_ckpt:
+                # window-boundary snapshot: everything the resume needs
+                # to continue this negotiation under any mesh
+                a = [np.asarray(v) for v in jax.device_get(
+                    (occ, acc, paths, sink_delay, all_reached, bb,
+                     crit_d))]
+                result.checkpoint = RouteCheckpoint(
+                    occ=a[0], acc=a[1], paths=a[2], sink_delay=a[3],
+                    all_reached=a[4], bb=a[5], crit=a[6],
+                    it_done=it_done, pres=pres,
+                    driver=dict(
+                        widx=widx, dirty=dirty.copy(),
+                        colors=(None if colors is None
+                                else np.asarray(colors).copy()),
+                        wide=wide.copy(), bb_full=bb_full.copy(),
+                        best_over=best_over,
+                        stall_windows=stall_windows,
+                        sweep_boost=sweep_boost, precise=precise,
+                        full_reroute_done=full_reroute_done,
+                        force_all_next=force_all_next,
+                        widened_nets=result.widened_nets))
+                next_ckpt = it_done + opts.checkpoint_every
+                mlog.log("elastic", event="checkpoint",
+                         it_done=it_done, pres=round(pres, 4))
         else:
             result.iterations = opts.max_router_iterations
 
+        mlog.close()
         result.wirelength = int(wirelength_on_device(dev, paths))
         result.paths = np.asarray(paths)
         result.sink_delay = np.asarray(sink_delay)
@@ -594,7 +696,8 @@ class Router:
     def route(self, term: NetTerminals,
               crit: Optional[np.ndarray] = None,
               timing_cb: Optional[Callable[["RouteResult"], np.ndarray]]
-              = None, analyzer=None) -> RouteResult:
+              = None, analyzer=None,
+              resume: Optional[RouteCheckpoint] = None) -> RouteResult:
         """Route all nets.  crit [R, Smax] per-sink criticalities (0 =>
         pure congestion-driven).  timing_cb, if given, is called after each
         iteration with the current result and must return updated per-sink
@@ -608,6 +711,8 @@ class Router:
         iteration host callback."""
         if analyzer is not None and self.pg is None and timing_cb is None:
             timing_cb = analyzer.timing_cb
+        if resume is not None and self.pg is None:
+            raise ValueError("resume is supported by the planes program")
         opts = self.opts
         rr, dev = self.rr, self.dev
         R, Smax = term.sinks.shape
@@ -643,12 +748,27 @@ class Router:
         else:
             span0 = 8
         L = path_budget(span0, self.max_len)
-        paths = jnp.full((R, Smax, L), N, dtype=jnp.int32)
-        sink_delay = jnp.full((R, Smax), jnp.inf, dtype=jnp.float32)
-        all_reached = jnp.zeros(R, dtype=bool)
-        bb = jnp.asarray(np.stack(
-            [term.bb_xmin, term.bb_xmax, term.bb_ymin, term.bb_ymax],
-            axis=1).astype(np.int32))
+        if resume is None:
+            paths = jnp.full((R, Smax, L), N, dtype=jnp.int32)
+        else:
+            # re-upload the checkpointed negotiation under THIS mesh
+            # (elastic shrink/grow: the sharding comes from this
+            # Router's layout, not the checkpoint's origin); no fresh
+            # allocation — the checkpoint IS the path store
+            occ = self._put_node(jnp.asarray(resume.occ))
+            acc = self._put_node(jnp.asarray(resume.acc))
+            paths = jnp.asarray(resume.paths)
+            crit = resume.crit
+        if resume is None:
+            sink_delay = jnp.full((R, Smax), jnp.inf, dtype=jnp.float32)
+            all_reached = jnp.zeros(R, dtype=bool)
+            bb = jnp.asarray(np.stack(
+                [term.bb_xmin, term.bb_xmax, term.bb_ymin, term.bb_ymax],
+                axis=1).astype(np.int32))
+        else:
+            sink_delay = jnp.asarray(resume.sink_delay)
+            all_reached = jnp.asarray(resume.all_reached)
+            bb = jnp.asarray(resume.bb)
         full_bb = jnp.asarray(np.array(
             [0, rr.grid.nx + 1, 0, rr.grid.ny + 1], dtype=np.int32))
         source_d = jnp.asarray(term.source.astype(np.int32))
@@ -720,7 +840,8 @@ class Router:
             return self._route_planes_windows(
                 term, crit, timing_cb, analyzer, occ, acc, paths,
                 sink_delay, all_reached, bb, full_bb, source_d, sinks_d,
-                planes_tbl, nsinks_np, cx_np, cy_np, result, B)
+                planes_tbl, nsinks_np, cx_np, cy_np, result, B,
+                resume=resume)
         if win is not None:
             result.windowed_nets = int((~wide).sum())
         n_over = -1                      # previous iteration's overuse

@@ -1,0 +1,82 @@
+"""Elastic checkpoint / resume of the negotiation (SURVEY §5.3/§5.4).
+
+The reference's closest mechanism is the MPI router's communicator
+halving (mpi_route…encoded.cxx:1560-1680): live route state moves onto
+fewer ranks mid-negotiation.  Here a RouteCheckpoint snapshots the
+complete state at a window boundary and the SAME negotiation resumes
+under a different mesh layout — shrink (device loss), grow, or down to
+a single chip — with the host scheduling state restored.
+"""
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.flow import synth_flow
+from parallel_eda_tpu.parallel.shard import make_mesh
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
+
+pytestmark = pytest.mark.slow  # full-flow gate (pytest.ini)
+
+
+def _flow():
+    return synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                      chan_width=12, seed=3)
+
+
+def test_checkpoint_resume_single_device():
+    """Interrupt (max_router_iterations cap), resume from the
+    checkpoint, converge; resumed runs are deterministic."""
+    f = _flow()
+    opts_a = RouterOpts(batch_size=32, checkpoint_every=2,
+                        max_router_iterations=4)
+    res_a = Router(f.rr, opts_a).route(f.term)
+    assert not res_a.success          # interrupted mid-negotiation
+    ck = res_a.checkpoint
+    assert ck is not None and ck.it_done >= 2
+
+    opts_b = RouterOpts(batch_size=32)
+    res_b = Router(f.rr, opts_b).route(f.term, resume=ck)
+    assert res_b.success
+    check_route(f.rr, f.term, res_b.paths, occ=res_b.occ)
+    # determinism: the same resume reproduces bit-identical results
+    res_c = Router(f.rr, opts_b).route(f.term, resume=ck)
+    assert np.array_equal(res_b.paths, res_c.paths)
+    assert np.array_equal(res_b.occ, res_c.occ)
+
+
+def test_elastic_shrink_mesh_to_single():
+    """Start sharded on a (4, 2) mesh, 'lose' the mesh after a
+    checkpoint, finish the SAME negotiation single-device — the
+    communicator-halving analogue, state re-laid-out by device_put."""
+    f = _flow()
+    mesh = make_mesh(8, shape=(4, 2))
+    opts_a = RouterOpts(batch_size=16, checkpoint_every=2,
+                        max_router_iterations=4)
+    res_a = Router(f.rr, opts_a, mesh=mesh).route(f.term)
+    ck = res_a.checkpoint
+    assert ck is not None
+
+    res_b = Router(f.rr, RouterOpts(batch_size=16)).route(
+        f.term, resume=ck)
+    assert res_b.success
+    check_route(f.rr, f.term, res_b.paths, occ=res_b.occ)
+
+    # mesh -> mesh is also legal (grow back / different shape)
+    res_m = Router(f.rr, RouterOpts(batch_size=16),
+                   mesh=make_mesh(8, shape=(2, 4))).route(
+        f.term, resume=ck)
+    assert res_m.success
+    # single-device and re-meshed resumes agree bit-for-bit (the
+    # sharded program is bit-identical to single-device)
+    assert np.array_equal(res_b.paths, res_m.paths)
+    assert np.array_equal(res_b.occ, res_m.occ)
+
+
+def test_resume_rejected_for_ell():
+    f = _flow()
+    r = Router(f.rr, RouterOpts(batch_size=32, checkpoint_every=2,
+                                max_router_iterations=4))
+    ck = r.route(f.term).checkpoint
+    with pytest.raises(ValueError):
+        Router(f.rr, RouterOpts(batch_size=32, program="ell")).route(
+            f.term, resume=ck)

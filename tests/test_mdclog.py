@@ -1,0 +1,40 @@
+"""Structured per-(window, category) logging (zlog/MDC equivalent,
+parallel_route/log.cxx:40-68)."""
+
+import json
+import os
+
+import pytest
+
+from parallel_eda_tpu.mdclog import CATEGORIES, MdcLogger
+
+
+def test_disabled_is_noop(tmp_path):
+    log = MdcLogger(None)
+    assert not log.enabled
+    log.log("route", x=1)            # must not write or raise
+    log.close()
+
+
+def test_mdc_routing(tmp_path):
+    log = MdcLogger(str(tmp_path))
+    log.set_mdc(window=1)
+    log.log("route", iteration=2, rerouted=5)
+    log.log("congestion", overused_nodes=3)
+    log.set_mdc(window=2)
+    log.log("route", iteration=4, rerouted=1)
+    log.close()
+    p1 = tmp_path / "logs" / "window_1" / "route.log"
+    p2 = tmp_path / "logs" / "window_2" / "route.log"
+    pc = tmp_path / "logs" / "window_1" / "congestion.log"
+    assert p1.exists() and p2.exists() and pc.exists()
+    rec = json.loads(p1.read_text().strip())
+    assert rec["iteration"] == 2 and rec["rerouted"] == 5 and "t" in rec
+    assert json.loads(p2.read_text().strip())["iteration"] == 4
+
+
+def test_unknown_category_rejected(tmp_path):
+    log = MdcLogger(str(tmp_path))
+    with pytest.raises(ValueError):
+        log.log("nonsense", x=1)
+    assert set(CATEGORIES) >= {"route", "congestion", "timing"}

@@ -1,0 +1,58 @@
+"""Native C++ serial PathFinder == Python serial_ref, bit-for-bit.
+
+The C++ router (native/serial_route.cc) is the honest serial-CPU
+speed-class baseline (stock VPR is C++; route_timing.c:85 semantics);
+the Python serial_ref is the algorithmic oracle.  Same double
+arithmetic, same heap tie-breaks => identical route trees, occupancy,
+iteration counts, and heap-pop counts on bidir and unidir graphs, with
+and without criticalities.
+"""
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import unidir_arch
+from parallel_eda_tpu.flow import prepare, run_place, synth_flow
+from parallel_eda_tpu.netlist.generate import generate_circuit
+from parallel_eda_tpu.route.serial_native import (NativeSerialRouter,
+                                                 native_available)
+from parallel_eda_tpu.route.serial_ref import SerialRouter
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="g++ toolchain unavailable")
+
+
+def _norm(trees):
+    return [sorted(t) for t in trees]
+
+
+def _check_match(rr, term, crit=None):
+    rp = SerialRouter(rr).route(term, crit=crit)
+    rn = NativeSerialRouter(rr).route(term, crit=crit)
+    assert rp.success == rn.success
+    assert rp.iterations == rn.iterations
+    assert rp.heap_pops == rn.heap_pops
+    assert rp.wirelength == rn.wirelength
+    assert np.array_equal(rp.occ, rn.occ)
+    assert _norm(rp.trees) == _norm(rn.trees)
+    return rn
+
+
+def test_native_matches_python_bidir():
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=3)
+    _check_match(f.rr, f.term)
+
+
+@pytest.mark.slow
+def test_native_matches_python_unidir_with_crit():
+    arch = unidir_arch(chan_width=14)
+    nl = generate_circuit(num_luts=40, num_inputs=6, num_outputs=6,
+                          K=arch.K, seed=3)
+    f = prepare(nl, arch, 14, seed=5)
+    f = run_place(f, timing_driven=False)
+    rng = np.random.default_rng(0)
+    crit = (rng.uniform(0, 0.9, f.term.sinks.shape)
+            * (f.term.sinks >= 0)).astype(np.float32)
+    rn = _check_match(f.rr, f.term, crit=crit)
+    assert rn.success

@@ -38,7 +38,6 @@ def build(row: str):
         f = prepare(crc_xor_tree(16, 16, K=4), minimal_arch(chan_width=16),
                     16, seed=7)
     elif row == "hetero":
-        from parallel_eda_tpu.arch.builtin import k6_n10_mem_arch
         f = prepare(ram_pipeline(n_mems=2, addr_bits=4, data_bits=4),
                     k6_n10_mem_arch(addr_bits=4, data_bits=4), 24, seed=7)
     elif row.startswith("synth"):
