@@ -145,6 +145,10 @@ def main():
     ap.add_argument("--luts", type=int, default=60)
     ap.add_argument("--chan_width", type=int, default=12)
     ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--program", default="planes",
+                    choices=["planes", "planes_pallas", "ell"],
+                    help="device search program (planes_pallas = the "
+                         "VMEM-resident Pallas sweep kernel)")
     ap.add_argument("--scale", action="store_true",
                     help="the at-scale crossover config (VERDICT r3 #1): "
                          "a >=1200-LUT circuit, full negotiation on both "
@@ -196,7 +200,8 @@ def main():
     # warmup: one full route populates the compile cache for every
     # program variant the negotiation loop can hit; the SAME Router is
     # reused so the device-resident terminal tables are uploaded once
-    router = Router(rr, RouterOpts(batch_size=args.batch))
+    router = Router(rr, RouterOpts(batch_size=args.batch,
+                                   program=args.program))
     t0 = time.time()
     res = router.route(term)
     log(f"device warmup route: {time.time() - t0:.1f}s "

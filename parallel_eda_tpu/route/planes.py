@@ -589,6 +589,62 @@ def _turn_triples_into_x(pg: PlanesGraph, dy, idxy_canvas, crit_c, cc_x):
     return best, bsrc, bw
 
 
+def _sweep_costs(pg: PlanesGraph, crit_c, cc_x, cc_y):
+    """Scan step costs: pay switch delay + congestion only at span
+    breaks.  Unidir: a forward (increasing-coordinate) scan may cross a
+    break only on INC tracks, a backward scan only on DEC tracks —
+    crossing against a wire's direction is blocked (INF).  Within-span
+    motion stays free in both scans (the span is one node)."""
+    cost_x = crit_c * pg.delay_x + cc_x
+    cost_y = crit_c * pg.delay_y + cc_y
+    if pg.directional:
+        inc = pg.inc_track[:, None, None]
+        cfx = jnp.where(pg.brk_before_x, jnp.where(inc, cost_x, INF), 0.0)
+        cbx = jnp.where(pg.brk_after_x, jnp.where(inc, INF, cost_x), 0.0)
+        cfy = jnp.where(pg.brk_before_y, jnp.where(inc, cost_y, INF), 0.0)
+        cby = jnp.where(pg.brk_after_y, jnp.where(inc, INF, cost_y), 0.0)
+    else:
+        cfx = jnp.where(pg.brk_before_x, cost_x, 0.0)
+        cbx = jnp.where(pg.brk_after_x, cost_x, 0.0)
+        cfy = jnp.where(pg.brk_before_y, cost_y, 0.0)
+        cby = jnp.where(pg.brk_after_y, cost_y, 0.0)
+    wfx = jnp.where(pg.brk_before_x, pg.delay_x, 0.0)
+    wbx = jnp.where(pg.brk_after_x, pg.delay_x, 0.0)
+    wfy = jnp.where(pg.brk_before_y, pg.delay_y, 0.0)
+    wby = jnp.where(pg.brk_after_y, pg.delay_y, 0.0)
+    return cfx, cbx, cfy, cby, wfx, wbx, wfy, wby
+
+
+def _sweep_once(pg: PlanesGraph, s, crit_c, cc_x, cc_y, costs,
+                idxx, idxy):
+    """One relaxation sweep (2 x-scans, turn into y, 2 y-scans, turn
+    into x) over the (dist, pred, wenter) state — THE shared body of
+    the XLA program (planes_relax) and the Pallas VMEM-resident kernel
+    (planes_pallas.py)."""
+    cfx, cbx, cfy, cby, wfx, wbx, wfy, wby = costs
+    _, NX, NYp1 = pg.shape_x
+    dx, dy, predx, predy, wx, wy = s
+    dx, predx, wx = _scan_update(dx, predx, wx, cfx, wfx, idxx[None],
+                                 NYp1, 2, False)
+    dx, predx, wx = _scan_update(dx, predx, wx, cbx, wbx, idxx[None],
+                                 NYp1, 2, True)
+    tv, ts, tw = _turn_triples_into_y(pg, dx, idxx, crit_c, cc_y)
+    imp = tv < dy
+    dy = jnp.where(imp, tv, dy)
+    predy = jnp.where(imp, ts, predy)
+    wy = jnp.where(imp, tw, wy)
+    dy, predy, wy = _scan_update(dy, predy, wy, cfy, wfy, idxy[None],
+                                 1, 3, False)
+    dy, predy, wy = _scan_update(dy, predy, wy, cby, wby, idxy[None],
+                                 1, 3, True)
+    tv, ts, tw = _turn_triples_into_x(pg, dy, idxy, crit_c, cc_x)
+    imp = tv < dx
+    dx = jnp.where(imp, tv, dx)
+    predx = jnp.where(imp, ts, predx)
+    wx = jnp.where(imp, tw, wx)
+    return dx, dy, predx, predy, wx, wy
+
+
 def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
                  nsweeps: int, mesh=None):
     """Fixed-sweep planes relaxation with predecessor tracking.
@@ -644,53 +700,16 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     wx = wenter0[:, :ncx].reshape(B, W, NX, NYp1)
     wy = wenter0[:, ncx:].reshape(B, W, NXp1, NY)
 
-    # scan step costs: pay switch delay + congestion only at span breaks.
-    # Unidir: a forward (increasing-coordinate) scan may cross a break
-    # only on INC tracks, a backward scan only on DEC tracks — crossing
-    # against a wire's direction is blocked (INF).  Within-span motion
-    # stays free in both scans (the span is one node).
-    cost_x = crit_c * pg.delay_x + cc_x
-    cost_y = crit_c * pg.delay_y + cc_y
-    if pg.directional:
-        inc = pg.inc_track[:, None, None]
-        cfx = jnp.where(pg.brk_before_x, jnp.where(inc, cost_x, INF), 0.0)
-        cbx = jnp.where(pg.brk_after_x, jnp.where(inc, INF, cost_x), 0.0)
-        cfy = jnp.where(pg.brk_before_y, jnp.where(inc, cost_y, INF), 0.0)
-        cby = jnp.where(pg.brk_after_y, jnp.where(inc, INF, cost_y), 0.0)
-    else:
-        cfx = jnp.where(pg.brk_before_x, cost_x, 0.0)
-        cbx = jnp.where(pg.brk_after_x, cost_x, 0.0)
-        cfy = jnp.where(pg.brk_before_y, cost_y, 0.0)
-        cby = jnp.where(pg.brk_after_y, cost_y, 0.0)
-    wfx = jnp.where(pg.brk_before_x, pg.delay_x, 0.0)
-    wbx = jnp.where(pg.brk_after_x, pg.delay_x, 0.0)
-    wfy = jnp.where(pg.brk_before_y, pg.delay_y, 0.0)
-    wby = jnp.where(pg.brk_after_y, pg.delay_y, 0.0)
+    cfx, cbx, cfy, cby, wfx, wbx, wfy, wby = _sweep_costs(
+        pg, crit_c, cc_x, cc_y)
 
     def sweep(_, s):
-        dx, dy, predx, predy, wx, wy = s
-        dx, predx, wx = _scan_update(dx, predx, wx, cfx, wfx, idxx[None],
-                                     NYp1, 2, False)
-        dx, predx, wx = _scan_update(dx, predx, wx, cbx, wbx, idxx[None],
-                                     NYp1, 2, True)
-        tv, ts, tw = _turn_triples_into_y(pg, dx, idxx, crit_c, cc_y)
-        imp = tv < dy
-        dy = jnp.where(imp, tv, dy)
-        predy = jnp.where(imp, ts, predy)
-        wy = jnp.where(imp, tw, wy)
-        dy, predy, wy = _scan_update(dy, predy, wy, cfy, wfy, idxy[None],
-                                     1, 3, False)
-        dy, predy, wy = _scan_update(dy, predy, wy, cby, wby, idxy[None],
-                                     1, 3, True)
-        tv, ts, tw = _turn_triples_into_x(pg, dy, idxy, crit_c, cc_x)
-        imp = tv < dx
-        dx = jnp.where(imp, tv, dx)
-        predx = jnp.where(imp, ts, predx)
-        wx = jnp.where(imp, tw, wx)
+        s = _sweep_once(pg, s, crit_c, cc_x, cc_y,
+                        (cfx, cbx, cfy, cby, wfx, wbx, wfy, wby),
+                        idxx, idxy)
         # keep the loop-carried canvases pinned to the mesh layout so
         # GSPMD doesn't migrate them between sweeps
-        return (cshard(dx), cshard(dy), cshard(predx), cshard(predy),
-                cshard(wx), cshard(wy))
+        return tuple(cshard(t) for t in s)
 
     dx, dy, predx, predy, wx, wy = lax.fori_loop(
         0, nsweeps, sweep, (dx, dy, predx, predy, wx, wy))
@@ -716,7 +735,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                sink_uid_all, uid_cell, uid_ipin, uid_delay,
                sel, valid, force, full_bb,
                nsweeps: int, max_len: int, num_waves: int, group: int,
-               doubling: bool, mesh):
+               doubling: bool, mesh, use_pallas: bool = False):
     """One fused batch step (traceable body shared by the standalone
     per-batch wrapper and the window program): rip up the selected nets,
     re-route each against the occupancy view of everyone-but-itself with
@@ -838,8 +857,13 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
             jnp.take_along_axis(edelay_p1, jnp.minimum(wk, Ko), axis=1),
             0.0)
 
-        dist, pred, wenter = planes_relax(pg, d0, cc_flat, crit_c,
-                                          wenter0, nsweeps, mesh)
+        if use_pallas:
+            from .planes_pallas import planes_relax_pallas
+            dist, pred, wenter = planes_relax_pallas(
+                pg, d0, cc_flat, crit_c, wenter0, nsweeps)
+        else:
+            dist, pred, wenter = planes_relax(pg, d0, cc_flat, crit_c,
+                                              wenter0, nsweeps, mesh)
 
         # --- sink extraction from the per-net candidate tables ---
         dist_p1 = jnp.concatenate([dist, jnp.full((B, 1), INF)], axis=1)
@@ -1000,7 +1024,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
 @functools.partial(
     jax.jit,
     static_argnames=("nsweeps", "max_len", "num_waves", "group",
-                     "doubling", "mesh"),
+                     "doubling", "mesh", "use_pallas"),
     donate_argnames=("occ", "paths", "sink_delay", "all_reached", "bb"))
 def route_batch_resident_planes(
         pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
@@ -1010,7 +1034,7 @@ def route_batch_resident_planes(
         sink_uid_all, uid_cell, uid_ipin, uid_delay,
         sel, valid, full_bb,
         nsweeps: int, max_len: int, num_waves: int, group: int,
-        doubling: bool = False, mesh=None):
+        doubling: bool = False, mesh=None, use_pallas: bool = False):
     """Standalone one-batch wrapper of _step_core (resident-state
     contract of search.route_batch_resident; the host picked the nets,
     so force=True)."""
@@ -1020,7 +1044,7 @@ def route_batch_resident_planes(
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
         sink_uid_all, uid_cell, uid_ipin, uid_delay,
         sel, valid, jnp.bool_(True), full_bb,
-        nsweeps, max_len, num_waves, group, doubling, mesh)
+        nsweeps, max_len, num_waves, group, doubling, mesh, use_pallas)
     return (paths, sink_delay, all_reached, bb, occ,
             jnp.int32(nsweeps * num_waves))
 
@@ -1069,7 +1093,8 @@ def _mis_colors(dev: DeviceRRGraph, occ, paths, all_reached,
     jax.jit,
     static_argnames=("K_iters", "nsweeps", "max_len", "num_waves",
                      "group", "doubling", "topk", "n_colors", "mesh",
-                     "sta_depth", "crit_exp", "max_crit", "use_sdc"),
+                     "sta_depth", "crit_exp", "max_crit", "use_sdc",
+                     "use_pallas"),
     donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
                      "bb", "crit_all"))
 def route_window_planes(
@@ -1085,7 +1110,7 @@ def route_window_planes(
         n_colors: int = 5, mesh=None,
         tdev=None, req_seed=None, sta_depth: int = 0,
         crit_exp: float = 1.0, max_crit: float = 0.99,
-        use_sdc: bool = False):
+        use_sdc: bool = False, use_pallas: bool = False):
     """A WINDOW of K_iters complete PathFinder iterations as ONE device
     program: per iteration, every batch group in sel_plan [G, B] runs the
     fused rip-up/route/commit step (clean nets no-op via the device-side
@@ -1131,7 +1156,8 @@ def route_window_planes(
                     entry_delay_all,
                     sink_uid_all, uid_cell, uid_ipin, uid_delay,
                     sel_plan[g], valid_plan[g], force, full_bb,
-                    nsweeps, max_len, num_waves, group, doubling, mesh)
+                    nsweeps, max_len, num_waves, group, doubling, mesh,
+                    use_pallas)
                 return (occ2, paths2, sink_delay2, all_reached2, bb2,
                         nr + n_act, ng + 1)
 

@@ -1,0 +1,161 @@
+"""Pallas TPU kernel for the planes relaxation: the whole multi-sweep
+loop VMEM-resident, one net per grid step.
+
+Why this kernel exists (the round-3/4 perf plan): the XLA lowering of
+planes_relax materialises every scan/turn intermediate through HBM —
+per sweep that is ~15 canvas-sized reads+writes, so the sweep is
+HBM-bandwidth-bound.  One net's full state (dist/pred/wenter for both
+plane sets, the congestion canvases, and the static masks/delays) is a
+few MB for BASELINE-ladder devices — it FITS IN VMEM (~16 MB/core).
+This kernel grids over the batch and runs the ENTIRE nsweeps loop on
+one net's canvases without touching HBM in between: HBM traffic drops
+from O(nsweeps * canvases) to O(canvases).
+
+The sweep body is the SAME code as the XLA program (_sweep_once /
+_sweep_costs from planes.py, including the directional gating) — the
+two lowerings cannot drift.  Correctness is enforced by
+tests/test_planes_pallas.py in interpret mode (this container's TPU
+tunnel was down all round; the kernel is opt-in via
+RouterOpts(program="planes_pallas") until device-measured).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .planes import PlanesGraph, _sweep_costs, _sweep_once
+
+
+def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
+                  # refs: per-net state
+                  dx_ref, dy_ref, ccx_ref, ccy_ref, crit_ref, wx_ref,
+                  wy_ref,
+                  # refs: static planes metadata (same block for all b)
+                  bbx_ref, bax_ref, bby_ref, bay_ref,
+                  fx_ref, lx_ref, fy_ref, ly_ref,
+                  delx_ref, dely_ref, delr0_ref, delr1_ref, inc_ref,
+                  # outputs
+                  odx_ref, ody_ref, opx_ref, opy_ref, owx_ref, owy_ref):
+    """One grid step = one net: load canvases into VMEM values, rebuild
+    a PlanesGraph view over the loaded masks, run the shared sweep body
+    nsweeps times, store results."""
+    W, NX, NYp1 = pg_template.shape_x
+    _, NXp1, NY = pg_template.shape_y
+    ncx = W * NX * NYp1
+
+    pg = PlanesGraph(
+        node_of_cell=pg_template.node_of_cell,      # unused by sweeps
+        cell_of_node=pg_template.cell_of_node,
+        brk_before_x=bbx_ref[:] != 0, brk_after_x=bax_ref[:] != 0,
+        brk_before_y=bby_ref[:] != 0, brk_after_y=bay_ref[:] != 0,
+        first_x=fx_ref[:] != 0, last_x=lx_ref[:] != 0,
+        first_y=fy_ref[:] != 0, last_y=ly_ref[:] != 0,
+        delay_x=delx_ref[:], delay_y=dely_ref[:],
+        delay_y_rot0=delr0_ref[:], delay_y_rot1=delr1_ref[:],
+        directional=pg_template.directional,
+        inc_track=(inc_ref[:] != 0 if pg_template.directional else None),
+    )
+
+    dx = dx_ref[:]                      # [1, W, NX, NYp1]
+    dy = dy_ref[:]
+    cc_x = ccx_ref[:]
+    cc_y = ccy_ref[:]
+    crit_c = crit_ref[:].reshape(1, 1, 1, 1)
+    wx = wx_ref[:]
+    wy = wy_ref[:]
+
+    idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(W, NX, NYp1)
+    idxy = (ncx + jnp.arange(W * NXp1 * NY, dtype=jnp.int32)
+            ).reshape(W, NXp1, NY)
+    predx = jnp.broadcast_to(idxx[None], dx.shape)
+    predy = jnp.broadcast_to(idxy[None], dy.shape)
+
+    costs = _sweep_costs(pg, crit_c, cc_x, cc_y)
+
+    def body(_, s):
+        return _sweep_once(pg, s, crit_c, cc_x, cc_y, costs, idxx, idxy)
+
+    dx, dy, predx, predy, wx, wy = jax.lax.fori_loop(
+        0, nsweeps, body, (dx, dy, predx, predy, wx, wy))
+
+    odx_ref[:] = dx
+    ody_ref[:] = dy
+    opx_ref[:] = predx
+    opy_ref[:] = predy
+    owx_ref[:] = wx
+    owy_ref[:] = wy
+
+
+@functools.partial(jax.jit, static_argnames=("nsweeps", "interpret"))
+def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
+                        wenter0, nsweeps: int, interpret=None):
+    """Drop-in for planes.planes_relax with identical signature and
+    results, lowered as a Pallas kernel gridded over the batch.
+    interpret=None auto-selects the interpreter off-TPU (tests/CPU)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B = d0_flat.shape[0]
+    W, NX, NYp1 = pg.shape_x
+    _, NXp1, NY = pg.shape_y
+    ncx = W * NX * NYp1
+
+    shx = (W, NX, NYp1)
+    shy = (W, NXp1, NY)
+    dx0 = d0_flat[:, :ncx].reshape(B, *shx)
+    dy0 = d0_flat[:, ncx:].reshape(B, *shy)
+    ccx = cc_flat[:, :ncx].reshape(B, *shx)
+    ccy = cc_flat[:, ncx:].reshape(B, *shy)
+    wx0 = wenter0[:, :ncx].reshape(B, *shx)
+    wy0 = wenter0[:, ncx:].reshape(B, *shy)
+    critb = crit_c.reshape(B, 1)
+
+    def bspec(shape):
+        return pl.BlockSpec((1,) + shape,
+                            lambda b: (b,) + (0,) * len(shape))
+
+    def sspec(shape):
+        # static metadata: every grid step reads block 0
+        return pl.BlockSpec(shape, lambda b: (0,) * len(shape))
+
+    i8 = jnp.int8
+    inc = (pg.inc_track.astype(i8) if pg.directional
+           else jnp.zeros((W,), i8))
+    statics = (pg.brk_before_x.astype(i8), pg.brk_after_x.astype(i8),
+               pg.brk_before_y.astype(i8), pg.brk_after_y.astype(i8),
+               pg.first_x.astype(i8), pg.last_x.astype(i8),
+               pg.first_y.astype(i8), pg.last_y.astype(i8),
+               pg.delay_x, pg.delay_y, pg.delay_y_rot0, pg.delay_y_rot1,
+               inc)
+    static_specs = [sspec(a.shape) for a in statics]
+
+    f32 = jnp.float32
+    out_shapes = [jax.ShapeDtypeStruct((B,) + shx, f32),
+                  jax.ShapeDtypeStruct((B,) + shy, f32),
+                  jax.ShapeDtypeStruct((B,) + shx, jnp.int32),
+                  jax.ShapeDtypeStruct((B,) + shy, jnp.int32),
+                  jax.ShapeDtypeStruct((B,) + shx, f32),
+                  jax.ShapeDtypeStruct((B,) + shy, f32)]
+    out_specs = [bspec(shx), bspec(shy), bspec(shx), bspec(shy),
+                 bspec(shx), bspec(shy)]
+
+    kern = functools.partial(_sweep_kernel, pg, nsweeps)
+    dx, dy, px, py, wx, wy = pl.pallas_call(
+        kern,
+        grid=(B,),
+        in_specs=[bspec(shx), bspec(shy), bspec(shx), bspec(shy),
+                  pl.BlockSpec((1, 1), lambda b: (b, 0)),
+                  bspec(shx), bspec(shy)] + static_specs,
+        out_shape=out_shapes,
+        out_specs=out_specs,
+        interpret=interpret,
+    )(dx0, dy0, ccx, ccy, critb, wx0, wy0, *statics)
+
+    def flat(a, b):
+        return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
+                               axis=1)
+
+    return flat(dx, dy), flat(px, py), flat(wx, wy)

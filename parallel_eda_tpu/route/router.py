@@ -309,13 +309,19 @@ class Router:
         # path-length / BF-step bound: a bb-confined path can wind, give slack
         self.max_len = 4 * (nx + ny) + 64
         self.pg = None
-        if self.opts.program == "planes":
+        self.use_pallas = self.opts.program == "planes_pallas"
+        if self.use_pallas and mesh is not None:
+            raise ValueError(
+                "program='planes_pallas' does not support mesh sharding "
+                "yet (the Pallas kernel is single-device VMEM-resident); "
+                "use program='planes' for sharded runs")
+        if self.opts.program in ("planes", "planes_pallas"):
             from .planes import build_planes
             if rr.wire_switch_of_track is None:
-                raise ValueError("program='planes' needs a graph built by "
-                                 "rr.graph.build_rr_graph (track switch "
-                                 "map); use program='ell' for foreign "
-                                 "graphs")
+                raise ValueError(
+                    f"program={self.opts.program!r} needs a graph built "
+                    f"by rr.graph.build_rr_graph (track switch map); use "
+                    f"program='ell' for foreign graphs")
             self.pg = build_planes(rr)
         self.mesh = mesh
         self._s_batch = self._s_node = None
@@ -538,7 +544,8 @@ class Router:
                 jnp.int32(it_done + 1 if force_all_next
                           else opts.incremental_after),
                 K, nsweeps, L, waves, grp_w,
-                doubling, min(4096, N), 5, self.mesh, **sta_kw)
+                doubling, min(4096, N), 5, self.mesh,
+                use_pallas=self.use_pallas, **sta_kw)
             occ, acc, paths, sink_delay, all_reached, bb = out[:6]
             force_all_next = False
             # the ONE sync per window (dmax_hist rides along: the

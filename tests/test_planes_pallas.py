@@ -1,0 +1,93 @@
+"""Pallas planes-sweep kernel == XLA planes_relax, bit-for-bit.
+
+The kernel (route/planes_pallas.py) reuses the exact sweep body of the
+XLA program, so distances, predecessors, and enter-delay payloads must
+match exactly.  Runs in interpret mode (no TPU in the test
+environment); the same kernel lowers to Mosaic on real hardware.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import minimal_arch, unidir_arch
+from parallel_eda_tpu.route.planes import build_planes, planes_relax
+from parallel_eda_tpu.route.planes_pallas import planes_relax_pallas
+from parallel_eda_tpu.rr.graph import CHANX, CHANY, build_rr_graph
+from parallel_eda_tpu.rr.grid import DeviceGrid
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("arch,nx,ny,seed", [
+    (minimal_arch(chan_width=6), 4, 4, 0),
+    (unidir_arch(chan_width=6, length=2), 5, 4, 3),
+])
+def test_pallas_matches_xla(arch, nx, ny, seed):
+    grid = DeviceGrid(nx, ny, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    pg = build_planes(rr)
+    N = rr.num_nodes
+    B = 3
+    rng = np.random.default_rng(seed)
+    wires = np.where((rr.node_type == CHANX) | (rr.node_type == CHANY))[0]
+    noc = np.asarray(pg.node_of_cell)
+    seed_m = np.zeros((B, N), bool)
+    for b in range(B):
+        seed_m[b, rng.choice(wires, 2, replace=False)] = True
+    cong = rng.uniform(0.5, 2.0, (B, N)).astype(np.float32) * 1e-10
+    d0 = jnp.asarray(np.where(seed_m[:, noc], 0.0, np.inf)
+                     .astype(np.float32))
+    cc = jnp.asarray(cong[:, noc])
+    crit = jnp.asarray(rng.uniform(0, 0.8, (B, 1, 1, 1))
+                       .astype(np.float32))
+    w0 = jnp.zeros((B, pg.ncells), jnp.float32)
+
+    d_x, p_x, w_x = planes_relax(pg, d0, cc, crit, w0, 12)
+    d_p, p_p, w_p = planes_relax_pallas(pg, d0, cc, crit, w0, 12,
+                                        interpret=True)
+    a, b = np.asarray(d_x), np.asarray(d_p)
+    # distances agree to the ulp (the only residue is FMA contraction
+    # differences between the XLA and interpret lowerings of
+    # crit*delay + cc); predecessors and payloads are exact
+    assert ((np.isclose(a, b, rtol=1e-5, atol=1e-16))
+            | (np.isinf(a) & np.isinf(b))).all()
+    assert np.array_equal(np.asarray(p_x), np.asarray(p_p))
+    assert np.array_equal(np.asarray(w_x), np.asarray(w_p))
+
+
+@pytest.mark.slow
+def test_pallas_program_full_route_matches_xla():
+    """The full negotiated route through program='planes_pallas'
+    (interpret mode off-TPU) is legal, deterministic, and lands in the
+    same quality class as the XLA planes program.  (Bit-equality of
+    whole routes is NOT asserted across lowerings: the two backends may
+    FMA-contract crit*delay+cc differently, and a one-ulp cost tie can
+    legitimately pick a different equal-cost path.)"""
+    from parallel_eda_tpu.flow import synth_flow
+    from parallel_eda_tpu.route import Router, RouterOpts, check_route
+
+    f = synth_flow(num_luts=25, chan_width=12, seed=2)
+    r_x = Router(f.rr, RouterOpts(batch_size=16)).route(f.term)
+    r_p = Router(f.rr, RouterOpts(batch_size=16,
+                                  program="planes_pallas")).route(f.term)
+    assert r_x.success and r_p.success
+    check_route(f.rr, f.term, r_p.paths, occ=r_p.occ)
+    assert abs(r_p.wirelength - r_x.wirelength) <= \
+        max(5, 0.02 * r_x.wirelength)
+    # pallas program is deterministic with itself
+    r_p2 = Router(f.rr, RouterOpts(batch_size=16,
+                                   program="planes_pallas")).route(f.term)
+    assert np.array_equal(r_p.paths, r_p2.paths)
+
+
+def test_pallas_mesh_rejected():
+    import jax
+
+    from parallel_eda_tpu.flow import synth_flow
+    from parallel_eda_tpu.parallel.shard import make_mesh
+    from parallel_eda_tpu.route import Router, RouterOpts
+
+    f = synth_flow(num_luts=10, chan_width=10, seed=1)
+    mesh = make_mesh(min(8, len(jax.devices())))
+    with pytest.raises(ValueError):
+        Router(f.rr, RouterOpts(program="planes_pallas"), mesh=mesh)
