@@ -108,6 +108,23 @@ class BlockType:
 
 
 @dataclass
+class DirectSpec:
+    """Dedicated inter-block connection (``t_direct_inf``,
+    libarchfpga physical_types.h; Process_Directs in
+    read_xml_arch_file.c): OPIN ``from_pin`` of a ``from_type`` block at
+    (x, y) drives IPIN ``to_pin`` of the ``to_type`` block at
+    (x+dx, y+dy) through a dedicated wire that bypasses the general
+    routing fabric — carry chains, register shift chains."""
+    from_type: str
+    from_pin: int
+    to_type: str
+    to_pin: int
+    dx: int = 0
+    dy: int = 1
+    switch: int = -1            # -1 = delayless
+
+
+@dataclass
 class ColumnSpec:
     """Periodic column assignment of a heterogeneous block type
     (Stratix-IV-style RAM/DSP columns).
@@ -137,6 +154,8 @@ class Arch:
     block_types: List[BlockType] = field(default_factory=list)
     # heterogeneous column assignments (empty = homogeneous CLB interior)
     column_types: List[ColumnSpec] = field(default_factory=list)
+    # dedicated inter-block connections (<directlist>, Process_Directs)
+    directs: List[DirectSpec] = field(default_factory=list)
     # hard-block models (.subckt name -> block type name), read_blif.c
     # model lookup equivalent
     hard_models: Dict[str, str] = field(default_factory=dict)
@@ -151,7 +170,22 @@ class Arch:
     Fc_out_abs: Optional[int] = None
     Fc_in_abs: Optional[int] = None
 
-    def fc_frac(self, chan_width: int, is_out: bool) -> float:
+    # per-pin Fc overrides: (block type name, pin index) -> fraction /
+    # absolute track count (read_xml_arch_file.c Process_Fc
+    # <fc_override> semantics; win over the arch-wide default)
+    Fc_pin: Dict[Tuple[str, int], float] = field(default_factory=dict)
+    Fc_pin_abs: Dict[Tuple[str, int], int] = field(default_factory=dict)
+
+    def fc_frac(self, chan_width: int, is_out: bool,
+                type_name: Optional[str] = None,
+                pin: Optional[int] = None) -> float:
+        if type_name is not None and pin is not None:
+            ab = self.Fc_pin_abs.get((type_name, pin))
+            if ab is not None:
+                return min(1.0, ab / max(1, chan_width))
+            ov = self.Fc_pin.get((type_name, pin))
+            if ov is not None:
+                return min(1.0, ov)
         ab = self.Fc_out_abs if is_out else self.Fc_in_abs
         if ab is not None:
             return min(1.0, ab / max(1, chan_width))

@@ -18,8 +18,10 @@ import warnings
 import xml.etree.ElementTree as ET
 from typing import Optional
 
-from .model import (Arch, ColumnSpec, SegmentInf, SwitchInf, make_clb_type,
-                    make_hard_type, make_io_type)
+import re
+
+from .model import (Arch, ColumnSpec, DirectSpec, SegmentInf, SwitchInf,
+                    make_clb_type, make_hard_type, make_io_type)
 
 
 def _f(attrib: dict, key: str, default: float) -> float:
@@ -142,6 +144,116 @@ def read_arch_xml(path: str) -> Arch:
                 cluster_pb = pb
             else:
                 hard_pbs.append(pb)
+
+        # per-type (port name -> (first pin index, width)) maps so
+        # <direct> / fc overrides can resolve "type.port[k]" pin names:
+        # inputs take indices 0.., outputs follow (the make_*_type pin
+        # numbering)
+        port_ranges: dict = {}
+        for pb in ([cluster_pb] if cluster_pb is not None else []) \
+                + hard_pbs:
+            tname = pb.attrib.get("name", "")
+            ranges = {}
+            off = 0
+            for e in pb.findall("input"):
+                w = int(float(e.attrib.get("num_pins", 0)))
+                ranges[e.attrib.get("name", "")] = (off, w)
+                off += w
+            for e in pb.findall("output"):
+                w = int(float(e.attrib.get("num_pins", 0)))
+                ranges[e.attrib.get("name", "")] = (off, w)
+                off += w
+            port_ranges[tname] = ranges
+
+        # the built cluster BlockType is always named "clb"
+        # (make_clb_type); XML names like "lab" must map onto it for
+        # directs / fc overrides to land on the built type
+        cluster_xml_name = (cluster_pb.attrib.get("name", "clb")
+                            if cluster_pb is not None else "clb")
+
+        def _built_name(t: str) -> str:
+            return "clb" if t == cluster_xml_name else t
+
+        def _pin_index(ref: str):
+            """'type.port[k]', 'type.port[hi:lo]' or 'type.port' ->
+            (built type name, first pin index, bit count)."""
+            m = re.fullmatch(
+                r"(\w+)\.(\w+)(?:\[(\d+)(?::(\d+))?\])?", ref.strip())
+            if not m:
+                return None
+            t, port, hi, lo = m.groups()
+            r = port_ranges.get(t, {}).get(port)
+            if r is None:
+                return None
+            if hi is None:
+                return _built_name(t), r[0], r[1]      # whole port
+            if lo is None:
+                return _built_name(t), r[0] + int(hi), 1
+            a, b = int(hi), int(lo)
+            return _built_name(t), r[0] + min(a, b), abs(a - b) + 1
+
+        # <directlist> (Process_Directs): dedicated inter-block wires
+        dl = root.find("directlist")
+        if dl is not None:
+            for d in dl.findall("direct"):
+                a = d.attrib
+                fp = _pin_index(a.get("from_pin", ""))
+                tp = _pin_index(a.get("to_pin", ""))
+                if fp is None or tp is None:
+                    warnings.warn(f"{path}: direct "
+                                  f"{a.get('name', '?')}: unresolvable "
+                                  f"pin name; skipped")
+                    continue
+                if fp[2] != tp[2]:
+                    warnings.warn(f"{path}: direct "
+                                  f"{a.get('name', '?')}: from/to bit "
+                                  f"widths differ; skipped")
+                    continue
+                sw = -1
+                if a.get("switch_name"):
+                    names = [x.name for x in arch.switches]
+                    if a["switch_name"] in names:
+                        sw = names.index(a["switch_name"])
+                    else:
+                        warnings.warn(
+                            f"{path}: direct {a.get('name', '?')}: "
+                            f"unknown switch {a['switch_name']!r}; "
+                            f"using the delayless switch")
+                for k in range(fp[2]):       # bitwise pairs over ranges
+                    arch.directs.append(DirectSpec(
+                        from_type=fp[0], from_pin=fp[1] + k,
+                        to_type=tp[0], to_pin=tp[1] + k,
+                        dx=int(float(a.get("x_offset", 0))),
+                        dy=int(float(a.get("y_offset", 0))),
+                        switch=sw))
+
+        # per-pin Fc overrides: VPR8 <fc_override port_name=.../>, VPR7
+        # <pin name=... fc_val=...> under <fc> (Process_Fc)
+        for pb in ([cluster_pb] if cluster_pb is not None else []) \
+                + hard_pbs:
+            tname = pb.attrib.get("name", "")
+            for fc in pb.iter("fc"):
+                for ov in list(fc.findall("fc_override")) \
+                        + list(fc.findall("pin")):
+                    a = ov.attrib
+                    pname = a.get("port_name") or a.get("name", "")
+                    if "." not in pname:
+                        pname = f"{tname}.{pname}"
+                    val = _f(a, "fc_val", _f(a, "fc", -1.0))
+                    pr = _pin_index(pname)
+                    if pr is None or val < 0:
+                        warnings.warn(f"{path}: fc override {pname!r} "
+                                      f"unresolvable; skipped")
+                        continue
+                    t, base, width = pr
+                    is_abs = a.get("fc_type", "frac").lower() == "abs"
+                    for k in range(width):
+                        if is_abs:
+                            arch.Fc_pin_abs[(t, base + k)] = \
+                                int(round(val))
+                        else:
+                            arch.Fc_pin[(t, base + k)] = val
+
         if cluster_pb is not None:
             num_in = sum(int(float(e.attrib.get("num_pins", 0)))
                          for e in cluster_pb.findall("input"))

@@ -254,6 +254,13 @@ class PlanesTerminals:
     uid_cell: np.ndarray        # int32 [U+1, K] wire cell (pad Ncells)
     uid_ipin: np.ndarray        # int32 [U+1, K] IPIN node (pad N)
     uid_delay: np.ndarray       # f32  [U+1, K] delay wire->IPIN->SINK
+    # dedicated direct connections (OPIN->IPIN edges, t_direct_inf):
+    # per (net, sink) the best source-class OPIN that directly drives
+    # one of the sink's IPINs (-1 = none) — the planes wave compares
+    # this fabric-bypassing candidate against the relaxation candidates
+    direct_oidx: np.ndarray     # int32 [R, S] index into opin_node / -1
+    direct_ipin: np.ndarray     # int32 [R, S] IPIN node (pad N)
+    direct_delay: np.ndarray    # f32  [R, S] OPIN->IPIN->SINK delay
 
 
 def _ragged_flat(row_ptr: np.ndarray, nodes: np.ndarray):
@@ -345,8 +352,58 @@ def build_planes_terminals(rr: RRGraph, source: np.ndarray,
 
     sink_uid = np.full(R * S, U, dtype=np.int32)
     sink_uid[valid] = inv.astype(np.int32)
+
+    # --- direct connections: OPIN -> IPIN -> SINK candidates ---
+    # (small: one pass over the graph's direct edges only)
+    direct_oidx = np.full((R, S), -1, dtype=np.int32)
+    direct_ipin = np.full((R, S), N, dtype=np.int32)
+    direct_delay = np.zeros((R, S), dtype=np.float32)
+    ntype = rr.node_type
+    # OPIN -> IPIN edges present?
+    from ..rr.graph import IPIN as _IPIN, OPIN as _OPIN
+    e_is_direct = ((ntype[odst] == _IPIN)
+                   & (ntype.repeat(np.diff(orp))[...] == _OPIN)
+                   if len(odst) else np.zeros(0, bool))
+    if e_is_direct.any():
+        # ragged lookups over the REAL entries only (argwhere, not the
+        # dense R*O / R*S nested loops — those are millions of python
+        # iterations at synth10k scale)
+        opin_owner: dict = {}
+        for r, oi in np.argwhere(opin_node < N):
+            opin_owner.setdefault(int(opin_node[r, oi]),
+                                  []).append((int(r), int(oi)))
+        sink_slots: dict = {}
+        for r, s in np.argwhere(sinks >= 0):
+            sink_slots.setdefault(int(sinks[r, s]),
+                                  []).append((int(r), int(s)))
+        e_src_all = np.repeat(np.arange(N), np.diff(orp))
+        for e in np.where(e_is_direct)[0]:
+            o, ip = int(e_src_all[e]), int(odst[e])
+            if o not in opin_owner:
+                continue
+            esw = int(rr.out_switch[e])
+            d1 = (rr.switch_Tdel[esw] + rr.C[ip]
+                  * (rr.switch_R[esw] + 0.5 * rr.R[ip]))
+            for e2 in range(orp[ip], orp[ip + 1]):
+                snk = int(odst[e2])
+                if snk not in sink_slots:
+                    continue
+                sw2 = int(rr.out_switch[e2])
+                d2 = (rr.switch_Tdel[sw2] + rr.C[snk]
+                      * (rr.switch_R[sw2] + 0.5 * rr.R[snk]))
+                for (r, s) in sink_slots[snk]:
+                    for (ro, oi) in opin_owner[o]:
+                        if ro != r:
+                            continue
+                        dd = np.float32(d1 + d2)
+                        if (direct_oidx[r, s] < 0
+                                or dd < direct_delay[r, s]):
+                            direct_oidx[r, s] = oi
+                            direct_ipin[r, s] = ip
+                            direct_delay[r, s] = dd
     return PlanesTerminals(opin_node, entry_cell, entry_oidx, entry_delay,
-                           sink_uid.reshape(R, S), u_cell, u_ipin, u_del)
+                           sink_uid.reshape(R, S), u_cell, u_ipin, u_del,
+                           direct_oidx, direct_ipin, direct_delay)
 
 
 
@@ -733,6 +790,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                opin_node_all, entry_cell_all, entry_oidx_all,
                entry_delay_all,
                sink_uid_all, uid_cell, uid_ipin, uid_delay,
+               direct_oidx_all, direct_ipin_all, direct_delay_all,
                sel, valid, force, full_bb,
                nsweeps: int, max_len: int, num_waves: int, group: int,
                doubling: bool, mesh, use_pallas: bool = False):
@@ -764,6 +822,9 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
     b_scell = uid_cell[b_uid]                    # [B, S, K]
     b_sipin = uid_ipin[b_uid]
     b_swdel = uid_delay[b_uid]
+    b_doidx = direct_oidx_all[sel]               # [B, S] (-1 = none)
+    b_dipin = direct_ipin_all[sel]
+    b_ddel = direct_delay_all[sel]
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -782,6 +843,9 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         b_scell = c(b_scell, "net", None, None)
         b_sipin = c(b_sipin, "net", None, None)
         b_swdel = c(b_swdel, "net", None, None)
+        b_doidx = c(b_doidx, "net", None)
+        b_dipin = c(b_dipin, "net", None)
+        b_ddel = c(b_ddel, "net", None)
 
     arangeB = jnp.arange(B)
     O = b_opin.shape[1]
@@ -881,6 +945,19 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         ent_wdel = jnp.take_along_axis(b_swdel, kstar[:, :, None],
                                        axis=2)[:, :, 0]
 
+        # --- dedicated direct candidate (OPIN->IPIN->SINK, bypassing
+        # the fabric): competes with the relaxation candidates; the
+        # fabric wins exact ties (strict <) for determinism ---
+        has_d = b_doidx >= 0
+        ddu = jnp.take_along_axis(
+            opin_du, jnp.clip(b_doidx, 0, O - 1), axis=1)      # [B, S]
+        dip_cong = jnp.take_along_axis(congj_p1, b_dipin, axis=1)
+        dcost = jnp.where(has_d,
+                          ddu + crit_w[:, None] * b_ddel
+                          + cw[:, None] * dip_cong, INF)
+        use_direct = dcost < sink_dist
+        sink_dist = jnp.minimum(sink_dist, dcost)
+
         # --- pick up to `group` sinks: most critical, then nearest ---
         score = jnp.where(remaining & jnp.isfinite(sink_dist),
                           sink_dist - b_crit * 1e3, INF)
@@ -901,6 +978,15 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         pick_cell = jnp.where(
             pick_valid, jnp.take_along_axis(ent_cell, order, axis=1), 0)
         pick_wdel = jnp.take_along_axis(ent_wdel, order, axis=1)
+        # direct-connection picks: no canvas walk, 4-node path
+        pick_direct = (jnp.take_along_axis(use_direct, order, axis=1)
+                       & pick_valid)
+        pick_dipin = jnp.take_along_axis(b_dipin, order, axis=1)
+        pick_doidx = jnp.take_along_axis(jnp.clip(b_doidx, 0, O - 1),
+                                         order, axis=1)
+        pick_ddel = jnp.take_along_axis(b_ddel, order, axis=1)
+        pick_ipin = jnp.where(pick_direct, pick_dipin, pick_ipin)
+        pick_cell = jnp.where(pick_direct, 0, pick_cell)
 
         # --- pointer-chase traceback in cell space ---
         ar_b = arangeB[:, None]
@@ -927,20 +1013,24 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         wst0 = jnp.zeros((B, G, Kw), jnp.float32)
         cur, done, cells_w, nodes_w, wst = lax.fori_loop(
             0, Kw, walk_step,
-            (pick_cell, ~pick_valid, cells_w0, nodes_w0, wst0))
+            (pick_cell, ~pick_valid | pick_direct, cells_w0, nodes_w0,
+             wst0))
         # a walk is complete iff it reached a pred==self cell in budget
         nxt_last = jnp.take_along_axis(
             pred, jnp.clip(cur, 0, ncells - 1), axis=1)
         okw = pick_valid & (nxt_last == cur)
-        ok = okw                                              # [B, G]
+        # direct picks skip the walk entirely
+        ok = jnp.where(pick_direct, pick_valid, okw)          # [B, G]
 
         join = jnp.clip(cur, 0, ncells - 1)
-        at_entry = jnp.take_along_axis(entry_flag, join, axis=1) & ok
+        at_entry = (jnp.take_along_axis(entry_flag, join, axis=1) & ok
+                    & ~pick_direct)
         tdel_base = jnp.where(
             at_entry, 0.0,
             jnp.take_along_axis(tdel_cells, join, axis=1))     # [B, G]
         wsum = jnp.flip(jnp.cumsum(jnp.flip(wst, 2), axis=2), 2)
-        d_new = tdel_base + wsum[:, :, 0] + pick_wdel          # at sink
+        d_new = jnp.where(pick_direct, pick_ddel,
+                          tdel_base + wsum[:, :, 0] + pick_wdel)
 
         # entry suffix: which OPIN fed the winning entry cell
         wk_join = jnp.take_along_axis(wk, join, axis=1)        # [B, G]
@@ -954,7 +1044,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         dup = jnp.concatenate(
             [jnp.zeros((B, G, 1), bool),
              nodes_w[:, :, 1:] == nodes_w[:, :, :-1]], axis=2)
-        keep = ~dup & (nodes_w < N) & ok[:, :, None]
+        keep = ~dup & (nodes_w < N) & (ok & ~pick_direct)[:, :, None]
         posn = jnp.cumsum(keep, axis=2) - 1
         seg = jnp.full((B, G, max_len), N, jnp.int32)
         seg = seg.at[:, :, 0].set(jnp.where(ok, pick_sink, N))
@@ -970,6 +1060,14 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         seg = seg.at[ar_b, ar_g,
                      jnp.where(put_e, nkeep + 3, max_len)].set(
             jnp.broadcast_to(b_src[:, None], (B, G)), mode="drop")
+        # direct picks: 4-node path [sink, ipin, opin, source]
+        pdm = pick_direct & ok
+        d_opin = jnp.take_along_axis(b_opin, pick_doidx, axis=1)
+        seg = seg.at[ar_b, ar_g,
+                     jnp.where(pdm, 2, max_len)].set(d_opin, mode="drop")
+        seg = seg.at[ar_b, ar_g,
+                     jnp.where(pdm, 3, max_len)].set(
+            jnp.broadcast_to(b_src[:, None], (B, G)), mode="drop")
 
         # --- store results at the picked sink slots ---
         old = jnp.take_along_axis(wpaths, order[:, :, None], axis=1)
@@ -983,8 +1081,8 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         remaining = remaining.at[ar_b, order].set(old_rem & ~ok)
 
         # --- grow the tree (cell space), deterministically via min ---
-        walk_cells = jnp.where(ok[:, :, None], cells_w, ncells
-                               ).reshape(B, -1)
+        walk_cells = jnp.where((ok & ~pick_direct)[:, :, None], cells_w,
+                               ncells).reshape(B, -1)
         walk_tdel = (tdel_base[:, :, None] + wsum).reshape(B, -1)
         buf = jnp.full((B, ncells + 1), INF, jnp.float32)
         buf = buf.at[arangeB[:, None], walk_cells].min(walk_tdel)
@@ -993,6 +1091,9 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         seed_cells = seed_cells | newly
         opin_used = opin_used.at[arangeB[:, None],
                                  jnp.where(put_e, oidx_join, O)].set(
+            True, mode="drop") | opin_used
+        opin_used = opin_used.at[arangeB[:, None],
+                                 jnp.where(pdm, pick_doidx, O)].set(
             True, mode="drop") | opin_used
         return (seed_cells, tdel_cells, opin_used, remaining, wpaths,
                 delay, reached_all)
@@ -1032,6 +1133,7 @@ def route_batch_resident_planes(
         source_all, sinks_all, crit_all,
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
         sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
         sel, valid, full_bb,
         nsweeps: int, max_len: int, num_waves: int, group: int,
         doubling: bool = False, mesh=None, use_pallas: bool = False):
@@ -1043,6 +1145,7 @@ def route_batch_resident_planes(
         source_all, sinks_all, crit_all,
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
         sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
         sel, valid, jnp.bool_(True), full_bb,
         nsweeps, max_len, num_waves, group, doubling, mesh, use_pallas)
     return (paths, sink_delay, all_reached, bb, occ,
@@ -1103,6 +1206,7 @@ def route_window_planes(
         source_all, sinks_all, crit_all,
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
         sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
         sel_plan, valid_plan, full_bb,
         pres0, pres_mult, max_pres, acc_fac, it0, force_until,
         K_iters: int, nsweeps: int, max_len: int, num_waves: int,
@@ -1155,6 +1259,7 @@ def route_window_planes(
                     opin_node_all, entry_cell_all, entry_oidx_all,
                     entry_delay_all,
                     sink_uid_all, uid_cell, uid_ipin, uid_delay,
+                    direct_oidx_all, direct_ipin_all, direct_delay_all,
                     sel_plan[g], valid_plan[g], force, full_bb,
                     nsweeps, max_len, num_waves, group, doubling, mesh,
                     use_pallas)

@@ -333,7 +333,7 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                     cls = bt.pin_classes[k]
                     is_out = cls.direction == PIN_CLASS_DRIVER
                     node = (opin_of if is_out else ipin_of)[(x, y, z, p)]
-                    fc = arch.fc_frac(W, is_out)
+                    fc = arch.fc_frac(W, is_out, type_name=bt.name, pin=p)
                     pin_ptc = z * bt.num_pins + p
                     for side, (kind, ci, pos) in enumerate(adj):
                         if unidir and is_out:
@@ -366,6 +366,26 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
                                 add_edge(node, int(wire), sw)
                             else:
                                 add_edge(int(wire), node, arch.ipin_switch)
+
+    # ---- dedicated direct connections (<directlist>,
+    # physical_types.h t_direct_inf): OPIN -> IPIN of the offset
+    # neighbour through a private wire, bypassing the fabric ----
+    for d in arch.directs:
+        sw = d.switch if d.switch >= 0 else delayless
+        for x in range(nx + 2):
+            for y in range(ny + 2):
+                bt = type_at(x, y)
+                if bt is None or bt.name != d.from_type:
+                    continue
+                tx, ty = x + d.dx, y + d.dy
+                tt = type_at(tx, ty)
+                if tt is None or tt.name != d.to_type:
+                    continue
+                for z in range(bt.capacity):
+                    src_n = opin_of.get((x, y, z, d.from_pin))
+                    dst_n = ipin_of.get((tx, ty, z, d.to_pin))
+                    if src_n is not None and dst_n is not None:
+                        add_edge(src_n, dst_n, sw)
 
     # ---- switch-box edges (endpoint rule; subset + rotated mixing) ----
     # Straight continuations and same-index turns follow the subset rule
@@ -582,7 +602,7 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
 
 _LEGAL_EDGES = {
     SOURCE: {OPIN},
-    OPIN: {CHANX, CHANY},
+    OPIN: {CHANX, CHANY, IPIN},      # OPIN->IPIN = direct connection
     IPIN: {SINK},
     CHANX: {CHANX, CHANY, IPIN},
     CHANY: {CHANX, CHANY, IPIN},

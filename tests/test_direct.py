@@ -1,0 +1,140 @@
+"""Dedicated direct connections (<directlist>, t_direct_inf,
+Process_Directs in read_xml_arch_file.c): OPIN -> IPIN edges that bypass
+the general fabric (carry chains).  The builder emits them, the serial
+router uses them, and the planes program's direct candidate beats the
+fabric path and produces the 4-node [sink, ipin, opin, source] route.
+"""
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import minimal_arch
+from parallel_eda_tpu.arch.model import DirectSpec
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
+from parallel_eda_tpu.route.serial_ref import SerialRouter
+from parallel_eda_tpu.rr.graph import (IPIN, OPIN, build_rr_graph,
+                                       check_rr_graph)
+from parallel_eda_tpu.rr.grid import DeviceGrid
+from parallel_eda_tpu.rr.terminals import NetTerminals
+
+
+def _direct_arch():
+    arch = minimal_arch(chan_width=10)          # K=4, N=2, I=6
+    # CLB output pin I+0 = 6 drives input pin 0 of the block ABOVE
+    # (the vertical carry-chain shape place/macros.py aligns)
+    arch.directs = [DirectSpec(from_type="clb", from_pin=6,
+                               to_type="clb", to_pin=0, dx=0, dy=1)]
+    return arch
+
+
+def _build():
+    arch = _direct_arch()
+    grid = DeviceGrid(4, 4, arch.io_capacity)
+    rr = build_rr_graph(arch, grid, chan_width=10)
+    return arch, rr
+
+
+def test_builder_emits_direct_edges():
+    _, rr = _build()
+    check_rr_graph(rr)
+    src_ids = np.repeat(np.arange(rr.num_nodes), np.diff(rr.out_row_ptr))
+    is_direct = ((rr.node_type[src_ids] == OPIN)
+                 & (rr.node_type[rr.out_dst] == IPIN))
+    assert is_direct.sum() > 0
+    # every direct edge spans exactly (dx, dy) = (0, 1)
+    s, d = src_ids[is_direct], rr.out_dst[is_direct]
+    assert (rr.xlow[d] - rr.xlow[s] == 0).all()
+    assert (rr.ylow[d] - rr.ylow[s] == 1).all()
+
+
+def _chain_terminals(rr):
+    """One net per vertically adjacent CLB pair: out class of (x,y) ->
+    in class of (x,y+1) — exactly the direct's shape."""
+    nets = []
+    for x in range(1, rr.grid.nx + 1):
+        for y in range(1, rr.grid.ny):
+            s = rr.src_of.get((x, y, 0, 1))         # driver class
+            k = rr.sink_of.get((x, y + 1, 0, 0))    # input class
+            if s is not None and k is not None:
+                nets.append((s, k, x, y))
+    R = len(nets)
+    assert R > 0
+    sinks = np.full((R, 1), -1, dtype=np.int32)
+    source = np.zeros(R, dtype=np.int32)
+    for i, (s, k, x, y) in enumerate(nets):
+        source[i] = s
+        sinks[i, 0] = k
+    xs = np.array([n[2] for n in nets], dtype=np.int32)
+    ys = np.array([n[3] for n in nets], dtype=np.int32)
+    return NetTerminals(
+        net_ids=np.arange(R), source=source, sinks=sinks,
+        num_sinks=np.ones(R, dtype=np.int32),
+        bb_xmin=np.maximum(0, xs - 3),
+        bb_xmax=np.minimum(rr.grid.nx + 1, xs + 3),
+        bb_ymin=np.maximum(0, ys - 3),
+        bb_ymax=np.minimum(rr.grid.ny + 1, ys + 4))
+
+
+def test_xml_directlist_and_fc_overrides(tmp_path):
+    """<directlist> + per-pin <fc_override> parse with port-name
+    resolution (Process_Directs / Process_Fc semantics)."""
+    from parallel_eda_tpu.arch.xml_parser import read_arch_xml
+
+    xml = """<architecture>
+ <switchlist><switch name="mx" type="mux" R="500" Tdel="5e-11"/></switchlist>
+ <segmentlist><segment name="l1" length="1" freq="1" type="bidir">
+   <wire_switch name="mx"/></segment></segmentlist>
+ <complexblocklist>
+  <pb_type name="io" capacity="4"/>
+  <pb_type name="clb">
+   <input name="I" num_pins="6"/>
+   <input name="cin" num_pins="1"/>
+   <output name="O" num_pins="2"/>
+   <output name="cout" num_pins="1"/>
+   <fc default_in_val="0.5" default_out_val="0.5">
+     <fc_override port_name="clb.cin" fc_val="0"/>
+     <fc_override port_name="clb.cout" fc_val="0"/>
+   </fc>
+   <pb_type blif_model=".names"><input name="in" num_pins="4"/></pb_type>
+  </pb_type>
+ </complexblocklist>
+ <directlist>
+  <direct name="carry" from_pin="clb.cout" to_pin="clb.cin"
+          x_offset="0" y_offset="1" z_offset="0"/>
+ </directlist>
+</architecture>"""
+    p = tmp_path / "direct.xml"
+    p.write_text(xml)
+    arch = read_arch_xml(str(p))
+    assert len(arch.directs) == 1
+    d = arch.directs[0]
+    assert (d.from_type, d.from_pin, d.to_type, d.to_pin, d.dx, d.dy) \
+        == ("clb", 9, "clb", 6, 0, 1)
+    # carry pins withdrawn from the fabric (Fc 0)
+    assert arch.Fc_pin[("clb", 6)] == 0.0
+    assert arch.Fc_pin[("clb", 9)] == 0.0
+    assert arch.fc_frac(12, True, "clb", 9) == 0.0
+    assert arch.fc_frac(12, True, "clb", 7) == 0.5
+
+
+@pytest.mark.slow
+def test_direct_routes_bypass_fabric():
+    _, rr = _build()
+    term = _chain_terminals(rr)
+    # serial oracle: chain nets ride the direct edges (zero wires)
+    rs = SerialRouter(rr).route(term)
+    assert rs.success
+    assert rs.wirelength == 0, "serial route should use only directs"
+
+    # planes program: same zero-wirelength result, 4-node paths
+    rp = Router(rr, RouterOpts(batch_size=16)).route(term)
+    assert rp.success
+    check_route(rr, term, rp.paths, occ=rp.occ)
+    assert rp.wirelength == 0, "planes route should use only directs"
+    N = rr.num_nodes
+    for r in range(term.num_nets):
+        seg = rp.paths[r, 0]
+        seg = seg[seg < N]
+        assert len(seg) == 4, f"net {r}: path {seg} is not direct"
+        assert rr.node_type[seg[1]] == IPIN
+        assert rr.node_type[seg[2]] == OPIN
