@@ -47,7 +47,21 @@ NET, NODE = "net", "node"
 def make_mesh(n_devices: Optional[int] = None,
               shape: Optional[Tuple[int, int]] = None) -> Mesh:
     """2-D (net, node) mesh over the first devices.  shape=None puts all
-    devices on the net axis (pure net parallelism)."""
+    devices on the net axis (pure net parallelism).
+
+    Multi-slice placement (the reference's MPI-over-cluster analogue,
+    SURVEY §5.8): jax.devices() orders devices slice-major, so with
+    shape=(num_slices * k, node_per_slice) the NODE axis (the
+    bandwidth-hungry spatial canvas shard + its scan prefix exchanges)
+    lands INSIDE each slice on ICI, while the NET axis — whose only
+    cross-shard traffic is the one int32 occupancy psum per window —
+    spans slices over DCN.  That is exactly the traffic split the
+    reference engineered by hand with per-rank rr-graph partitions and
+    packetized congestion broadcasts
+    (mpi_route_load_balanced_nonblocking_send_recv_encoded.cxx); here it
+    is an axis-ordering convention.  (Single-slice environments — like
+    this container's one tunneled chip — exercise the same code on a
+    virtual CPU mesh; see tests/test_parallel.py.)"""
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
