@@ -108,11 +108,22 @@ def sweep_microbench(args) -> None:
     # canvases ~15x (4 scans x (in+out) + turn stencils), ~4 B each;
     # achieved cell rate / HBM-bound rate = bandwidth utilization
     dev0 = jax.devices()[0]
-    kind = getattr(dev0, "device_kind", "") or dev0.platform
-    peak_bw = 50e9 if dev0.platform == "cpu" else next(
-        (bw for key, bw in (("v5p", 2765e9), ("v5e", 819e9),
-                            ("v4", 1228e9), ("v6", 1638e9))
-         if key in kind.lower()), 819e9)
+    kind = (getattr(dev0, "device_kind", "") or dev0.platform).lower()
+    # libtpu kind strings vary ("TPU v5", "TPU v5 lite", "TPU v5p",
+    # "TPU v4", ...); match the lite variants before the bare "v5"
+    if dev0.platform == "cpu":
+        peak_bw = 50e9
+    elif "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        peak_bw = 819e9
+    elif "v5" in kind:                   # v5p / bare "TPU v5"
+        peak_bw = 2765e9
+    elif "v4" in kind:
+        peak_bw = 1228e9
+    elif "v6" in kind or "trillium" in kind:
+        peak_bw = 1638e9
+    else:
+        peak_bw = 819e9                  # conservative default
+
     bytes_per_cell_sweep = 15 * 4.0
     hbm_bound_rate = peak_bw / bytes_per_cell_sweep
     for nx, W in ((16, 12), (32, 14), (64, 16), (96, 20)):

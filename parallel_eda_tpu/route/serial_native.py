@@ -20,7 +20,8 @@ import numpy as np
 
 from ..rr.graph import CHANX, CHANY, RRGraph
 from ..rr.terminals import NetTerminals
-from .serial_ref import SerialRouteResult, SerialRouter
+from .serial_ref import (SerialRouteResult, SerialRouter,
+                         tree_order)
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native",
@@ -146,9 +147,13 @@ class NativeSerialRouter:
                     "heap_pops": int(pops.value)}])
         for r in range(R):
             lo, hi = int(tree_off[r]), int(tree_off[r + 1])
-            res.trees.append(
-                [(int(tree_flat[2 * k]), int(tree_flat[2 * k + 1]))
-                 for k in range(lo, hi)])
+            rows = [(int(tree_flat[2 * k]), int(tree_flat[2 * k + 1]))
+                    for k in range(lo, hi)]
+            # the C core appends each sink's backtrack target-first
+            # (children before parents); re-establish the
+            # SerialRouteResult TREE-order contract with the shared
+            # helper
+            res.trees.append(tree_order(rows))
         return res
 
 

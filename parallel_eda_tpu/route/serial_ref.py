@@ -45,6 +45,32 @@ class SerialRouteResult:
     timed_out: bool = False
 
 
+def tree_order(rows):
+    """Re-order (node, parent) rows into TREE order — SOURCE first,
+    every parent before its children (the SerialRouteResult contract;
+    consumers like qor.serial_sink_delays accumulate delays in one
+    forward pass).  Input rows must contain a (src, -1) root."""
+    if not rows:
+        return []
+    out = [rows[0]]
+    seen = {rows[0][0]}
+    pending = [rv for rv in rows[1:]]
+    while pending:
+        rest = []
+        progressed = False
+        for v, pnode in pending:
+            if pnode in seen:
+                out.append((v, pnode))
+                seen.add(v)
+                progressed = True
+            else:
+                rest.append((v, pnode))
+        if not progressed:
+            break
+        pending = rest
+    return out
+
+
 class SerialRouter:
     """Host serial PathFinder over the shared RRGraph arrays."""
 
@@ -147,26 +173,13 @@ class SerialRouter:
         res.route_time_s = time.time() - t0
         res.heap_pops = pops
         res.occ = occ
-        # tree order output
+        # tree order output (shared helper; also used by the native
+        # C++ binding, serial_native.py)
         out_trees: List[List[tuple]] = []
         for i in range(R):
-            rows = [(int(term.source[i]), -1)]
-            seen = {int(term.source[i])}
-            pending = [(v, p) for v, p in trees[i].items() if p != -1]
-            while pending:
-                rest = []
-                progressed = False
-                for v, p in pending:
-                    if p in seen:
-                        rows.append((v, p))
-                        seen.add(v)
-                        progressed = True
-                    else:
-                        rest.append((v, p))
-                if not progressed:
-                    break
-                pending = rest
-            out_trees.append(rows)
+            rows = [(int(term.source[i]), -1)] + \
+                [(v, p) for v, p in trees[i].items() if p != -1]
+            out_trees.append(tree_order(rows))
         res.trees = out_trees
         wire = (rr.node_type == CHANX) | (rr.node_type == CHANY)
         used = np.zeros(N, dtype=bool)

@@ -35,6 +35,13 @@ def _check_match(rr, term, crit=None):
     assert rp.wirelength == rn.wirelength
     assert np.array_equal(rp.occ, rn.occ)
     assert _norm(rp.trees) == _norm(rn.trees)
+    # the SerialRouteResult contract: TREE order — parents before
+    # children (qor.serial_sink_delays accumulates in one forward pass)
+    for t in rn.trees:
+        seen = set()
+        for v, p in t:
+            assert p == -1 or p in seen, "tree rows out of order"
+            seen.add(v)
     return rn
 
 
