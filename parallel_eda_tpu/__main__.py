@@ -294,6 +294,10 @@ def main(argv=None) -> int:
             p2 = os.path.join(args.draw, "routing.svg")
             write_routing_svg(flow, p2)
             drawn.append(p2)
+        from .viewer import write_interactive_html
+        p3 = os.path.join(args.draw, "viewer.html")
+        write_interactive_html(flow, p3)
+        drawn.append(p3)
         print("drew " + " ".join(drawn))
 
     if args.power and flow.route is not None:

@@ -110,6 +110,27 @@ def test_cli_draw_svg(tmp_path):
         body = open(p).read()
         assert body.startswith("<svg") and "</svg>" in body
 
+    # the interactive viewer is emitted alongside and embeds a
+    # self-consistent model (graphics.c/draw.c equivalent surface)
+    import json
+    import re
+
+    html = open(os.path.join(draw, "viewer.html")).read()
+    assert "<canvas" in html and "wheel" in html.lower()
+    m = re.search(r"const M = (\{.*?\});\n", html, re.S)
+    assert m, "embedded model not found"
+    model = json.loads(m.group(1))
+    assert model["routed"] and model["wires"], "no routed wires embedded"
+    nwires = len(model["wires"])
+    for net in model["nets"]:
+        assert all(0 <= w < nwires for w in net["w"])
+        assert 0 <= net["d"] < len(model["blocks"])
+    # every non-global routable net with sinks got wires or is a
+    # direct/adjacent route; at least one net must reference wires
+    assert any(net["w"] for net in model["nets"])
+    for w in model["wires"]:
+        assert w["o"] >= 1 and w["c"] >= 1
+
 
 def test_cli_settings_file_and_conflicts(tmp_path):
     import pytest
