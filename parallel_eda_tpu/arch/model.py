@@ -201,6 +201,14 @@ class Arch:
     # deterministic staggered pattern, and the packer must verify each
     # cluster is intra-routable (pack/cluster_legality.c semantics)
     xbar_density: float = 1.0
+    # switch-block pattern (<switch_block type= fs=>, ProcessSwitchblocks).
+    # The rr builder implements ONE pattern co-designed with the planes
+    # kernel's roll stencils: subset continuations/turns + parity-rotated
+    # mixing turns (Fs=3-class, the Wilton index-permutation property —
+    # rr/graph.py "switch-box edges").  The parser records what the XML
+    # asked for; the builder warns when it differs.
+    sb_type: str = "subset_rotated"
+    sb_fs: int = 3
 
     def block_type(self, name: str) -> BlockType:
         for t in self.block_types:

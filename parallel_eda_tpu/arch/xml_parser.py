@@ -278,6 +278,16 @@ def read_arch_xml(path: str) -> Arch:
     # Fc: prefer the logic cluster's own <fc>; fall back to <device>.  The io
     # pb_type's fc (typically 1.0) must never win, so no document-wide search.
     dev = root.find("device")
+    # <switch_block type="wilton|subset|universal" fs="3">
+    # (ProcessSwitchblocks): recorded on the Arch; the builder implements
+    # its co-designed subset+rotated pattern and warns LOUDLY when the
+    # XML asked for a different one — an explicit, visible approximation
+    # instead of a silent one (rr/graph.py emits the warning)
+    if dev is not None:
+        sb = dev.find("switch_block")
+        if sb is not None:
+            arch.sb_type = sb.attrib.get("type", "subset").lower()
+            arch.sb_fs = int(float(sb.attrib.get("fs", 3)))
     if not (cluster_pb is not None and _read_fc(cluster_pb)):
         if dev is not None:
             _read_fc(dev)

@@ -220,22 +220,48 @@ def write_stats_files(stats_dir: str, result: "RouteResult") -> None:
         f.write(f"final_crit_path_delay {cpd:.6e}\n")
 
 
+def _median_cut_bins(pts_x: np.ndarray, pts_y: np.ndarray,
+                     depth: int = 4) -> np.ndarray:
+    """Recursive median cuts over net centers (new_partitioner.cxx /
+    split_nets_recursive semantics): alternate x/y cuts at the median,
+    so every leaf holds ~the same NUMBER of nets regardless of placement
+    density — a fixed grid starves bins on clustered placements.
+    Returns a leaf id per point; deterministic (stable half-splits on
+    degenerate medians)."""
+    n = len(pts_x)
+    bins = np.zeros(n, dtype=np.int64)
+
+    def cut(sel: np.ndarray, d: int, vert: bool) -> None:
+        if d == 0 or sel.size <= 1:
+            return
+        vals = pts_x[sel] if vert else pts_y[sel]
+        left = vals <= np.median(vals)
+        if left.all() or not left.any():
+            order = np.argsort(vals, kind="stable")
+            left = np.zeros(sel.size, dtype=bool)
+            left[order[: sel.size // 2]] = True
+        bins[sel[~left]] += 1 << (d - 1)
+        cut(sel[left], d - 1, not vert)
+        cut(sel[~left], d - 1, not vert)
+
+    cut(np.arange(n), depth, True)
+    return bins
+
+
 def _spatial_order(idx: np.ndarray, cx: np.ndarray, cy: np.ndarray,
-                   nx: int, ny: int, grid_bins: int = 4) -> np.ndarray:
+                   depth: int = 4) -> np.ndarray:
     """Order nets so consecutive ones come from DIFFERENT regions of the
-    device: bin net centers into a grid_bins x grid_bins map and deal
-    round-robin across bins.  Consecutive nets become one batch, so batch
-    peers are spatially spread — less overlap, fewer congestion conflicts
-    per commit (the net-axis load-balancing role of the reference's
-    spatial net partitioning, split_nets_recursive
+    device: median-cut-partition net centers into 2^depth balanced
+    leaves and deal round-robin across them.  Consecutive nets become
+    one batch, so batch peers are spatially spread — less overlap, fewer
+    congestion conflicts per commit (the net-axis load-balancing role of
+    the reference's spatial net partitioning, split_nets_recursive
     partitioning_multi_sink_delta_stepping_route.cxx:2648 +
     new_partitioner.cxx median cuts, re-aimed at batches instead of
     threads)."""
     if len(idx) <= 1:
         return idx
-    bx = np.clip((cx[idx] * grid_bins) // max(1, nx + 2), 0, grid_bins - 1)
-    by = np.clip((cy[idx] * grid_bins) // max(1, ny + 2), 0, grid_bins - 1)
-    bins = (bx * grid_bins + by).astype(np.int64)
+    bins = _median_cut_bins(cx[idx], cy[idx], depth)
     # stable sort by bin, then deal one net per bin per round
     order = np.argsort(bins, kind="stable")
     sorted_bins = bins[order]
@@ -247,7 +273,7 @@ def _spatial_order(idx: np.ndarray, cx: np.ndarray, cy: np.ndarray,
     return idx[order[deal]]
 
 
-def _order_and_chunk(g, nsinks, cx, cy, nx, ny, B):
+def _order_and_chunk(g, nsinks, cx, cy, B):
     """Shared batch formation: fanout classes (similar wave depth),
     spatial round-robin within a class, chunked to B (used by both the
     window planner and the ELL per-iteration loop)."""
@@ -256,7 +282,7 @@ def _order_and_chunk(g, nsinks, cx, cy, nx, ny, B):
     cls = np.ceil(np.log2(np.maximum(
         1, nsinks[g]).astype(float))).astype(np.int64)
     ordered = np.concatenate([
-        _spatial_order(g[cls == c], cx, cy, nx, ny)
+        _spatial_order(g[cls == c], cx, cy)
         for c in sorted(set(cls.tolist()), reverse=True)])
     return [ordered[lo:lo + B] for lo in range(0, len(ordered), B)]
 
@@ -387,8 +413,7 @@ class Router:
             cd = colors[dirty]
             groups = [dirty[cd == c] for c in np.unique(cd)]
         for g in groups:
-            batches.extend(_order_and_chunk(
-                g, nsinks, cx, cy, self.rr.grid.nx, self.rr.grid.ny, B))
+            batches.extend(_order_and_chunk(g, nsinks, cx, cy, B))
         if not batches:
             batches = [np.zeros(0, dtype=np.int64)]
         # pad the group count to a power of two: G is a traced shape, so
@@ -893,8 +918,7 @@ class Router:
                          else (g,))
                 for gp in parts:
                     batches.extend(_order_and_chunk(
-                        gp, nsinks_np, cx_np, cy_np, rr.grid.nx,
-                        rr.grid.ny, B))
+                        gp, nsinks_np, cx_np, cy_np, B))
 
             # one static wave cap for every batch: the wave loop is a
             # device while_loop that exits early once all sinks are done,

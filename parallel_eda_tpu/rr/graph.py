@@ -128,6 +128,20 @@ def build_rr_graph(arch: Arch, grid: DeviceGrid,
     nx, ny = grid.nx, grid.ny
     num_seg = len(arch.segments)
 
+    if getattr(arch, "sb_type", "subset_rotated") not in (
+            "subset", "subset_rotated"):
+        import warnings
+
+        warnings.warn(
+            f"arch requests switch_block type={arch.sb_type!r} "
+            f"fs={arch.sb_fs}; this builder implements its co-designed "
+            "subset+rotated pattern (same O(W) switch count, the Wilton "
+            "index-permutation property via parity-rotated turns — "
+            "rr/graph.py switch-box notes).  Connectivity is a superset "
+            "of subset and QoR-equivalent in the committed gates, but "
+            "track-level topology will differ from VPR's "
+            f"{arch.sb_type} box.")
+
     dirs = {s.directionality for s in arch.segments}
     if len(dirs) > 1:
         raise ValueError(f"segments mix directionalities {dirs}; the rr "

@@ -28,7 +28,7 @@ import json
 
 import numpy as np
 
-from .draw import _EXTRA_FILLS, _TYPE_FILL
+from .draw import _TYPE_FILL, _tile_fill
 
 
 def _flow_model(flow) -> dict:
@@ -39,6 +39,7 @@ def _flow_model(flow) -> dict:
     nx, ny = grid.nx, grid.ny
 
     tiles = []
+    extra: dict = {}
     fills = dict(_TYPE_FILL)
     for x in range(nx + 2):
         for y in range(ny + 2):
@@ -46,9 +47,7 @@ def _flow_model(flow) -> dict:
                 continue
             tname = ("io" if grid.is_io(x, y)
                      else grid.interior_type_name(x))
-            if tname not in fills:
-                fills[tname] = _EXTRA_FILLS[len(fills) % len(
-                    _EXTRA_FILLS)]
+            fills.setdefault(tname, _tile_fill(tname, extra))
             tiles.append([x, y, tname])
 
     blocks = [{"n": b.name, "t": b.type_name,
@@ -161,7 +160,7 @@ window.addEventListener('resize', resize);
 
 function fit() {
   const m = document.getElementById('main');
-  T.s = Math.min(m.clientWidth, m.clientHeight) / (H + 2);
+  T.s = Math.min(m.clientWidth / (M.nx + 4), m.clientHeight / (H + 2));
   T.x = T.y = T.s; draw();
 }
 
@@ -263,16 +262,17 @@ cv.onwheel = e => {
 function hover(e) {
   const x = Math.floor((e.offsetX - T.x) / T.s);
   const y = H - 1 - Math.floor((e.offsetY - T.y) / T.s);
-  let txt = '';
-  for (const w of M.wires) {                  // nearest wire first
+  let txt = '', best = 3;                     // nearest wire within 3px
+  for (const w of M.wires) {
     const [x0, y0, x1, y1] = wireXY(w);
     const d = w.h ? Math.abs(e.offsetY - y0) : Math.abs(e.offsetX - x0);
     const inSpan = w.h
       ? (e.offsetX >= x0 && e.offsetX <= x1)
       : (e.offsetY >= Math.min(y0, y1) && e.offsetY <= Math.max(y0, y1));
-    if (d < 3 && inSpan) {
+    if (d < best && inSpan) {
+      best = d;
       txt = (w.h ? 'CHANX' : 'CHANY') + ' track ' + w.p +
-            ' occ ' + w.o + '/' + w.c; break;
+            ' occ ' + w.o + '/' + w.c;
     }
   }
   if (!txt) {

@@ -158,7 +158,7 @@ def test_spatial_order_round_robins_bins():
     idx = np.arange(8)
     cx = np.array([1, 1, 1, 1, 9, 9, 9, 9])
     cy = np.array([1, 1, 1, 1, 9, 9, 9, 9])
-    out = _spatial_order(idx, cx, cy, nx=8, ny=8, grid_bins=2)
+    out = _spatial_order(idx, cx, cy, depth=1)
     halves = (cx[out] > 4).astype(int)
     # dealing one net per bin per round alternates the two regions
     assert np.abs(np.diff(halves)).sum() == 7, halves.tolist()

@@ -41,6 +41,28 @@ def test_rr_graph_minimal():
     assert n_src == grid.nx * grid.ny + n_io_tiles * arch.io_capacity
 
 
+def test_rr_graph_sb_type_divergence_warns():
+    """An arch asking for a switch-block pattern the builder does not
+    implement (wilton/universal) must produce a VISIBLE warning, not a
+    silent approximation (ProcessSwitchblocks / rr_graph_sbox.c)."""
+    import warnings
+
+    arch = minimal_arch(chan_width=8)
+    arch.sb_type, arch.sb_fs = "universal", 3
+    grid = DeviceGrid(3, 3, arch.io_capacity)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        rr = build_rr_graph(arch, grid)
+    assert any("switch_block" in str(w.message) for w in rec)
+    check_rr_graph(rr)
+
+    arch2 = minimal_arch(chan_width=8)      # co-designed pattern: quiet
+    with warnings.catch_warnings(record=True) as rec2:
+        warnings.simplefilter("always")
+        build_rr_graph(arch2, grid)
+    assert not any("switch_block" in str(w.message) for w in rec2)
+
+
 def test_rr_graph_length2_segments():
     arch = minimal_arch(chan_width=8)
     arch.segments[0].length = 2

@@ -38,6 +38,9 @@ def test_parse_k6_frac_n10():
     assert clb.T_comb >= 2.61e-10          # the LUT delay_matrix max
     assert abs(clb.T_setup - 6.6e-11) < 1e-15
     assert abs(clb.T_clk_to_q - 1.24e-10) < 1e-15
+    # <switch_block> recorded (ProcessSwitchblocks); the builder's
+    # pattern divergence is warned at build time, not silently ignored
+    assert arch.sb_type == "wilton" and arch.sb_fs == 3
     # memory column: hard type + subckt model + gridlocations cols
     mem = arch.block_type("memory")
     assert mem.num_input_pins == 15 and mem.num_output_pins == 8
