@@ -81,11 +81,26 @@ def init_backend(retries: int = 3, delay_s: float = 20.0,
     return jax.devices()[0].platform
 
 
-def build(num_luts: int, chan_width: int, seed: int = 11):
+def build(num_luts: int, chan_width: int, seed: int = 11,
+          place: bool = False):
     from parallel_eda_tpu.flow import synth_flow
 
     flow = synth_flow(num_luts=num_luts, num_inputs=12, num_outputs=12,
                       chan_width=chan_width, seed=seed)
+    if place:
+        # anneal before routing (the flow's normal shape).  The 60-LUT
+        # smoke config has always routed from the initial placement and
+        # keeps doing so for cross-round comparability, but at >=600
+        # LUTs an unannealed placement is effectively unroutable at any
+        # sane W (measured: diffuse ~9% wire overuse after 50 serial
+        # iterations at 600 LUTs/W=20), so the at-scale config MUST
+        # place first.  The native C++ annealer keeps this host-side
+        # and deterministic — no extra device programs to compile.
+        from parallel_eda_tpu.flow import run_place_native
+
+        flow = run_place_native(flow)
+        log(f"placed {flow.pnl.num_blocks} blocks in "
+            f"{flow.times['place']:.1f}s (native SA)")
     return flow
 
 
@@ -236,7 +251,8 @@ def main():
     if args.sweep_only:
         sweep_microbench(args)
         return
-    flow = build(num_luts=args.luts, chan_width=args.chan_width)
+    flow = build(num_luts=args.luts, chan_width=args.chan_width,
+                 place=args.scale)
     rr, term = flow.rr, flow.term
     R = term.sinks.shape[0]
     log(f"circuit: {R} nets, rr graph {rr.num_nodes} nodes, "

@@ -105,6 +105,25 @@ def synth_flow(num_luts: int = 100, num_inputs: int = 8,
     return prepare(nl, arch, chan_width, bb_factor=bb_factor)
 
 
+def run_place_native(flow: FlowResult, seed: int = 7,
+                     inner_num: float = 1.0) -> FlowResult:
+    """Anneal with the native C++ serial placer (place/serial_sa.py) and
+    refresh net terminals — the host-side fast path for benches and
+    tools that need a good placement without compiling the device
+    placer's programs.  Same invariant as run_place: any position
+    change must re-derive the terminals."""
+    from .place.serial_sa import serial_sa_place
+
+    t0 = time.time()
+    res = serial_sa_place(flow.pnl, flow.grid, flow.pos, seed=seed,
+                          inner_num=inner_num)
+    flow.pos = res.pos
+    flow.times["place"] = time.time() - t0
+    flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,
+                              bb_factor=flow.bb_factor)
+    return flow
+
+
 def run_place(flow: FlowResult,
               opts: Optional[PlacerOpts] = None,
               timing_driven: bool = True) -> FlowResult:
