@@ -123,6 +123,8 @@ def sweep_microbench(args) -> None:
     if args.program == "planes_pallas":
         from parallel_eda_tpu.route.planes_pallas import (
             planes_relax_pallas)
+    if args.sweep_crop:
+        from parallel_eda_tpu.route.planes import planes_relax_cropped
 
     rows = []
     # analytic roofline constants (the MFU-style statement for a
@@ -166,7 +168,17 @@ def sweep_microbench(args) -> None:
         cc = jnp.ones((B, pg.ncells), jnp.float32) * 1e-9
         crit = jnp.zeros((B, 1, 1, 1), jnp.float32)
         w0 = jnp.zeros((B, pg.ncells), jnp.float32)
-        if args.program == "planes_pallas":
+        if args.sweep_crop:
+            # per-net bb-cropped relaxation at a fixed tile: measures
+            # the crop's REAL per-sweep cost on this backend, slice +
+            # scatter overhead included
+            t = min(args.sweep_crop, nx - 1)
+            rng = np.random.default_rng(3)
+            ox = jnp.asarray(rng.integers(0, nx - t, B), jnp.int32)
+            oy = jnp.asarray(rng.integers(0, nx - t, B), jnp.int32)
+            fn = jax.jit(lambda d: planes_relax_cropped(
+                pg, d, cc, crit, w0, nsweeps, ox, oy, t, t)[0])
+        elif args.program == "planes_pallas":
             fn = jax.jit(lambda d: planes_relax_pallas(
                 pg, d, cc, crit, w0, nsweeps)[0])
         else:
@@ -179,7 +191,12 @@ def sweep_microbench(args) -> None:
             out = fn(d0)
         np.asarray(out)                        # real sync (axon rule)
         dt = (time.time() - t0) / (reps * nsweeps)
-        cells = B * pg.ncells
+        if args.sweep_crop:
+            # swept work is the tile, not the grid
+            t = min(args.sweep_crop, nx - 1)
+            cells = B * W * 2 * t * (t + 1)
+        else:
+            cells = B * pg.ncells
         util = cells / dt / hbm_bound_rate
         rows.append({"grid": f"{nx}x{nx}", "W": W, "cells": pg.ncells,
                      "ms_per_sweep": round(dt * 1e3, 3),
@@ -199,6 +216,7 @@ def sweep_microbench(args) -> None:
         "vs_baseline": 0.0,
         "detail": {"platform": jax.devices()[0].platform,
                    "batch": args.batch, "program": args.program,
+                   "sweep_crop": args.sweep_crop,
                    "rows": rows}}))
 
 
@@ -220,6 +238,10 @@ def main():
                     help="microbench the planes relaxation per-sweep "
                          "device cost and exit")
     ap.add_argument("--sweep_max_grid", type=int, default=96)
+    ap.add_argument("--sweep_crop", type=int, default=0,
+                    help="with --sweep_only: measure the bb-CROPPED "
+                         "relaxation at this tile size (per-net random "
+                         "origins) instead of full canvases")
     ap.add_argument("--serial_timeout", type=float, default=0.0,
                     help="cap serial baseline wall seconds (0 = none); "
                          "a timed-out serial run reports its elapsed "

@@ -110,6 +110,9 @@ class PlanesGraph:
     # per-track INC mask
     directional: bool = struct.field(pytree_node=False, default=False)
     inc_track: Optional[jnp.ndarray] = None     # bool [W]
+    # longest wire span in grid units (static): the bb-crop margin —
+    # a wire INTERSECTING a net's bb can overhang it by max_span-1
+    max_span: int = struct.field(pytree_node=False, default=1)
 
     @property
     def shape_x(self):
@@ -223,6 +226,9 @@ def build_planes(rr: RRGraph) -> PlanesGraph:
         delay_y_rot0=j(delay_y_rot0), delay_y_rot1=j(delay_y_rot1),
         directional=rr.unidir,
         inc_track=(j(rr.dir_of_track == 0) if rr.unidir else None),
+        max_span=int(max(
+            (rr.xhigh[is_x] - rr.xlow[is_x] + 1).max(initial=1),
+            (rr.yhigh[is_y] - rr.ylow[is_y] + 1).max(initial=1))),
     )
 
 
@@ -444,7 +450,114 @@ def _scan_update(d, pred, w, cstep, wstep, self_idx, stride, axis,
             jnp.where(imp, wstep, w))
 
 
-def _turn_triples_into_y(pg: PlanesGraph, dx, idxx_canvas, crit_c, cc_y):
+@struct.dataclass
+class PlanesGeom:
+    """Sweep-body geometry with an explicit leading broadcast axis G:
+    G == 1 (shared, the whole-grid program — arrays are the PlanesGraph
+    fields expanded with [None]) or G == B (per-net bb-CROPPED views of
+    the same arrays: each net's masks/delays/ids sliced at its crop
+    origin).  The sweep body is written once against this layout; the
+    crop is the planes analogue of the reference's per-net bounding
+    boxes (route.h:70-165) — work per net scales with its bb, not the
+    device.
+
+    idxx/idxy carry GLOBAL flat cell ids (pred payloads and scan
+    neighbor strides stay in global index space, so traceback and the
+    scatter-back are crop-agnostic); base_par carries the GLOBAL corner
+    parity (x + y) % 2 so rotated-turn parity survives cropping."""
+    brk_before_x: jnp.ndarray       # [G, W, X, Y+1] (crop-local X/Y)
+    brk_after_x: jnp.ndarray
+    brk_before_y: jnp.ndarray       # [G, W, X+1, Y]
+    brk_after_y: jnp.ndarray
+    first_x: jnp.ndarray
+    last_x: jnp.ndarray
+    first_y: jnp.ndarray
+    last_y: jnp.ndarray
+    delay_x: jnp.ndarray
+    delay_y: jnp.ndarray
+    delay_y_rot0: jnp.ndarray
+    delay_y_rot1: jnp.ndarray
+    idxx: jnp.ndarray               # int32 [G, W, X, Y+1] global ids
+    idxy: jnp.ndarray               # int32 [G, W, X+1, Y]
+    base_par: jnp.ndarray           # int32 [G, X+1, Y+1] global (x+y)%2
+    stride_x: int = struct.field(pytree_node=False, default=0)  # global NY+1
+    directional: bool = struct.field(pytree_node=False, default=False)
+    inc_track: Optional[jnp.ndarray] = None     # bool [W] (shared)
+
+    @property
+    def shape_x(self):
+        return self.brk_before_x.shape[1:]      # (W, X, Y+1) crop-local
+
+    @property
+    def shape_y(self):
+        return self.brk_before_y.shape[1:]
+
+
+def geom_full(pg: PlanesGraph) -> PlanesGeom:
+    """The G=1 shared geometry of the whole grid (views, no copies)."""
+    W, NX, NYp1 = pg.shape_x
+    _, NXp1, NY = pg.shape_y
+    ncx = W * NX * NYp1
+    idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(1, W, NX, NYp1)
+    idxy = (ncx + jnp.arange(W * NXp1 * NY, dtype=jnp.int32)
+            ).reshape(1, W, NXp1, NY)
+    base_par = ((jnp.arange(NX + 1)[:, None]
+                 + jnp.arange(NY + 1)[None, :]) % 2)[None]
+    return PlanesGeom(
+        brk_before_x=pg.brk_before_x[None], brk_after_x=pg.brk_after_x[None],
+        brk_before_y=pg.brk_before_y[None], brk_after_y=pg.brk_after_y[None],
+        first_x=pg.first_x[None], last_x=pg.last_x[None],
+        first_y=pg.first_y[None], last_y=pg.last_y[None],
+        delay_x=pg.delay_x[None], delay_y=pg.delay_y[None],
+        delay_y_rot0=pg.delay_y_rot0[None],
+        delay_y_rot1=pg.delay_y_rot1[None],
+        idxx=idxx, idxy=idxy, base_par=base_par,
+        stride_x=NYp1, directional=pg.directional,
+        inc_track=pg.inc_track)
+
+
+def geom_cropped(pg: PlanesGraph, ox, oy, cnx: int, cny: int,
+                 full: Optional[PlanesGeom] = None) -> PlanesGeom:
+    """Per-net cropped geometry: net b's slice starts at grid cell
+    (ox[b], oy[b]) and spans a STATIC (cnx, cny) tile (compile-time;
+    the caller buckets tile sizes).  Exact iff every wire a net may
+    legally use (bb-intersecting, see the window cc mask) lies inside
+    its tile — callers expand the bb by (max wire length - 1) and clamp
+    to the grid."""
+    full = full if full is not None else geom_full(pg)
+    W, NX, NYp1 = pg.shape_x
+    _, NXp1, NY = pg.shape_y
+
+    def crop(a, xs, ys):
+        # a: [1, W, X, Y]; per-net slice -> [B, W, xs, ys]
+        return jax.vmap(lambda x0, y0: lax.dynamic_slice(
+            a[0], (0, x0, y0), (a.shape[1], xs, ys)))(ox, oy)
+
+    def crop2(a, xs, ys):
+        return jax.vmap(lambda x0, y0: lax.dynamic_slice(
+            a[0], (x0, y0), (xs, ys)))(ox, oy)
+
+    return PlanesGeom(
+        brk_before_x=crop(full.brk_before_x, cnx, cny + 1),
+        brk_after_x=crop(full.brk_after_x, cnx, cny + 1),
+        brk_before_y=crop(full.brk_before_y, cnx + 1, cny),
+        brk_after_y=crop(full.brk_after_y, cnx + 1, cny),
+        first_x=crop(full.first_x, cnx, cny + 1),
+        last_x=crop(full.last_x, cnx, cny + 1),
+        first_y=crop(full.first_y, cnx + 1, cny),
+        last_y=crop(full.last_y, cnx + 1, cny),
+        delay_x=crop(full.delay_x, cnx, cny + 1),
+        delay_y=crop(full.delay_y, cnx + 1, cny),
+        delay_y_rot0=crop(full.delay_y_rot0, cnx + 1, cny),
+        delay_y_rot1=crop(full.delay_y_rot1, cnx + 1, cny),
+        idxx=crop(full.idxx, cnx, cny + 1),
+        idxy=crop(full.idxy, cnx + 1, cny),
+        base_par=crop2(full.base_par, cnx + 1, cny + 1),
+        stride_x=NYp1, directional=pg.directional,
+        inc_track=pg.inc_track)
+
+
+def _turn_triples_into_y(gm: PlanesGeom, dx, crit_c, cc_y):
     """Best switchbox-turn candidate INTO each chany cell from dx.
 
     Returns (val, src, w): [B, W, NX+1, NY] candidate cost, global source
@@ -456,23 +569,19 @@ def _turn_triples_into_y(pg: PlanesGraph, dx, idxx_canvas, crit_c, cc_y):
     parity = (x + v - b) mod 2 — a roll along the track axis applied
     identically to the value and index canvases."""
     B = dx.shape[0]
-    W, NX, NYp1 = pg.shape_x
+    W, NX, NYp1 = gm.shape_x
     NY = NYp1 - 1
+    G = gm.idxx.shape[0]
 
     def canvas_x(a, fill):
-        c = jnp.full((B, W, NX + 2, NY + 2), fill, a.dtype)
+        c = jnp.full((a.shape[0], W, NX + 2, NY + 2), fill, a.dtype)
         return c.at[:, :, 1:NX + 1, 0:NY + 1].set(a)
 
-    def canvas_ix(a, fill):
-        c = jnp.full((W, NX + 2, NY + 2), fill, a.dtype)
-        return c.at[:, 1:NX + 1, 0:NY + 1].set(a)
-
     cx_all = canvas_x(dx, INF)
-    cx_last = canvas_x(jnp.where(pg.last_x, dx, INF), INF)
-    cx_first = canvas_x(jnp.where(pg.first_x, dx, INF), INF)
-    ix = canvas_ix(idxx_canvas, jnp.int32(0))
+    cx_last = canvas_x(jnp.where(gm.last_x, dx, INF), INF)
+    cx_first = canvas_x(jnp.where(gm.first_x, dx, INF), INF)
+    ix = canvas_x(gm.idxx, jnp.int32(0))            # [G, W, NX+2, NY+2]
 
-    xg = jnp.arange(NX + 1)[:, None]
     best = jnp.full((B, W, NX + 1, NY), INF, dx.dtype)
     bsrc = jnp.zeros((B, W, NX + 1, NY), jnp.int32)
     bw = jnp.zeros((B, W, NX + 1, NY), jnp.float32)
@@ -483,72 +592,70 @@ def _turn_triples_into_y(pg: PlanesGraph, dx, idxx_canvas, crit_c, cc_y):
                 jnp.where(better, src, bsrc),
                 jnp.where(better, w, bw))
 
-    if pg.directional:
+    if gm.directional:
         # unidir (single-driver): the edge exists iff the SOURCE's
         # driving end is on the corner AND the TARGET starts there —
         # an AND of directed gates replaces the bidir endpoint OR.
         # INC chanx drives from last_x, DEC from first_x; INC chany
         # starts at first_y (corner below, b=1), DEC at last_y (b=0).
         # All edges use the target's switch (delay_y).
-        inc = pg.inc_track[:, None, None]
-        cx_src_inc = canvas_x(jnp.where(pg.last_x & inc, dx, INF), INF)
-        cx_src_dec = canvas_x(jnp.where(pg.first_x & ~inc, dx, INF), INF)
-        tgt_of_b = (pg.last_y & ~inc, pg.first_y & inc)
+        inc = gm.inc_track[:, None, None]
+        cx_src_inc = canvas_x(jnp.where(gm.last_x & inc, dx, INF), INF)
+        cx_src_dec = canvas_x(jnp.where(gm.first_x & ~inc, dx, INF), INF)
+        tgt_of_b = (gm.last_y & ~inc, gm.first_y & inc)
         for b_off in (0, 1):
             tgt_gate = tgt_of_b[b_off]
-            par = (xg + (jnp.arange(1, NY + 1)[None, :] - b_off)) % 2
+            par = gm.base_par[:, :, 1 - b_off:1 - b_off + NY]
             for a_off in (0, 1):
                 src_c = cx_src_inc if a_off == 0 else cx_src_dec
                 sl = (slice(None), slice(None),
                       slice(a_off, a_off + NX + 1),
                       slice(1 - b_off, 1 - b_off + NY))
-                sli = (slice(None),) + sl[2:]
                 cand = jnp.where(tgt_gate, src_c[sl], INF)
-                cand = cand + crit_c * pg.delay_y + cc_y
+                cand = cand + crit_c * gm.delay_y + cc_y
                 best, bsrc, bw = fold(best, bsrc, bw, cand,
-                                      ix[sli][None], pg.delay_y)
+                                      ix[sl], gm.delay_y)
                 for p in (0, 1):
                     if (1 + p) % W == 0:
                         continue
                     r_src = jnp.roll(src_c, 1 + p, axis=1)[sl]
-                    r_i = jnp.roll(ix, 1 + p, axis=0)[sli][None]
+                    r_i = jnp.roll(ix, 1 + p, axis=1)[sl]
                     cand = jnp.where(tgt_gate, r_src, INF)
-                    cand = cand + crit_c * pg.delay_y + cc_y
-                    cand = jnp.where(par[None, None] == p, cand, INF)
+                    cand = cand + crit_c * gm.delay_y + cc_y
+                    cand = jnp.where(par[:, None] == p, cand, INF)
                     best, bsrc, bw = fold(best, bsrc, bw, cand, r_i,
-                                          pg.delay_y)
+                                          gm.delay_y)
         return best, bsrc, bw
 
     for b_off in (0, 1):
-        tgt_gate = pg.last_y if b_off == 0 else pg.first_y
-        par = (xg + (jnp.arange(1, NY + 1)[None, :] - b_off)) % 2
+        tgt_gate = gm.last_y if b_off == 0 else gm.first_y
+        par = gm.base_par[:, :, 1 - b_off:1 - b_off + NY]
         for a_off in (0, 1):
             src_gated = cx_last if a_off == 0 else cx_first
             sl = (slice(None), slice(None),
                   slice(a_off, a_off + NX + 1),
                   slice(1 - b_off, 1 - b_off + NY))
-            sli = (slice(None),) + sl[2:]
             v_any = cx_all[sl]
             v_src = src_gated[sl]
-            src_i = ix[sli][None]
+            src_i = ix[sl]
             cand = jnp.minimum(v_src, jnp.where(tgt_gate, v_any, INF))
-            cand = cand + crit_c * pg.delay_y + cc_y
-            best, bsrc, bw = fold(best, bsrc, bw, cand, src_i, pg.delay_y)
+            cand = cand + crit_c * gm.delay_y + cc_y
+            best, bsrc, bw = fold(best, bsrc, bw, cand, src_i, gm.delay_y)
             for p in (0, 1):
                 if (1 + p) % W == 0:
                     continue
                 r_all = jnp.roll(cx_all, 1 + p, axis=1)[sl]
                 r_src = jnp.roll(src_gated, 1 + p, axis=1)[sl]
-                r_i = jnp.roll(ix, 1 + p, axis=0)[sli][None]
-                dly = pg.delay_y_rot0 if p == 0 else pg.delay_y_rot1
+                r_i = jnp.roll(ix, 1 + p, axis=1)[sl]
+                dly = gm.delay_y_rot0 if p == 0 else gm.delay_y_rot1
                 cand = jnp.minimum(r_src, jnp.where(tgt_gate, r_all, INF))
                 cand = cand + crit_c * dly + cc_y
-                cand = jnp.where(par[None, None] == p, cand, INF)
+                cand = jnp.where(par[:, None] == p, cand, INF)
                 best, bsrc, bw = fold(best, bsrc, bw, cand, r_i, dly)
     return best, bsrc, bw
 
 
-def _turn_triples_into_x(pg: PlanesGraph, dy, idxy_canvas, crit_c, cc_x):
+def _turn_triples_into_x(gm: PlanesGeom, dy, crit_c, cc_x):
     """Mirror of _turn_triples_into_y: candidates INTO the chanx plane.
     Target chanx cell (t, u, y) receives from chany cells (u-a, y+b) at
     corner (u-a, y); gates: src b=0: last_y, b=1: first_y; tgt a=0:
@@ -556,23 +663,18 @@ def _turn_triples_into_x(pg: PlanesGraph, dy, idxy_canvas, crit_c, cc_x):
     parity = (u-a+y) mod 2; both rotated directions use the CHANX track's
     switch (delay_x, see rr/graph.py edge emission)."""
     B = dy.shape[0]
-    W, NXp1, NY = pg.shape_y
+    W, NXp1, NY = gm.shape_y
     NX = NXp1 - 1
 
     def canvas_y(a, fill):
-        c = jnp.full((B, W, NX + 2, NY + 2), fill, a.dtype)
+        c = jnp.full((a.shape[0], W, NX + 2, NY + 2), fill, a.dtype)
         return c.at[:, :, 0:NX + 1, 1:NY + 1].set(a)
 
-    def canvas_iy(a, fill):
-        c = jnp.full((W, NX + 2, NY + 2), fill, a.dtype)
-        return c.at[:, 0:NX + 1, 1:NY + 1].set(a)
-
     cy_all = canvas_y(dy, INF)
-    cy_last = canvas_y(jnp.where(pg.last_y, dy, INF), INF)
-    cy_first = canvas_y(jnp.where(pg.first_y, dy, INF), INF)
-    iy = canvas_iy(idxy_canvas, jnp.int32(0))
+    cy_last = canvas_y(jnp.where(gm.last_y, dy, INF), INF)
+    cy_first = canvas_y(jnp.where(gm.first_y, dy, INF), INF)
+    iy = canvas_y(gm.idxy, jnp.int32(0))            # [G, W, NX+2, NY+2]
 
-    yg = jnp.arange(NY + 1)[None, :]
     best = jnp.full((B, W, NX, NY + 1), INF, dy.dtype)
     bsrc = jnp.zeros((B, W, NX, NY + 1), jnp.int32)
     bw = jnp.zeros((B, W, NX, NY + 1), jnp.float32)
@@ -583,118 +685,116 @@ def _turn_triples_into_x(pg: PlanesGraph, dy, idxy_canvas, crit_c, cc_x):
                 jnp.where(better, src, bsrc),
                 jnp.where(better, w, bw))
 
-    if pg.directional:
+    if gm.directional:
         # unidir mirror: INC chany drives from last_y (b=0, below the
         # corner), DEC from first_y (b=1); INC chanx starts at first_x
         # (corner left, a=1), DEC at last_x (a=0).  Target switch
         # throughout (delay_x, matching the builder's mux-at-start rule).
-        inc = pg.inc_track[:, None, None]
-        cy_src_inc = canvas_y(jnp.where(pg.last_y & inc, dy, INF), INF)
-        cy_src_dec = canvas_y(jnp.where(pg.first_y & ~inc, dy, INF), INF)
-        tgt_of_a = (pg.last_x & ~inc, pg.first_x & inc)
+        inc = gm.inc_track[:, None, None]
+        cy_src_inc = canvas_y(jnp.where(gm.last_y & inc, dy, INF), INF)
+        cy_src_dec = canvas_y(jnp.where(gm.first_y & ~inc, dy, INF), INF)
+        tgt_of_a = (gm.last_x & ~inc, gm.first_x & inc)
         for a_off in (0, 1):
             tgt_gate = tgt_of_a[a_off]
-            par = ((jnp.arange(1, NX + 1)[:, None] - a_off) + yg) % 2
+            par = gm.base_par[:, 1 - a_off:1 - a_off + NX, :]
             for b_off in (0, 1):
                 src_c = cy_src_inc if b_off == 0 else cy_src_dec
                 sl = (slice(None), slice(None),
                       slice(1 - a_off, 1 - a_off + NX),
                       slice(b_off, b_off + NY + 1))
-                sli = (slice(None),) + sl[2:]
                 cand = jnp.where(tgt_gate, src_c[sl], INF)
-                cand = cand + crit_c * pg.delay_x + cc_x
+                cand = cand + crit_c * gm.delay_x + cc_x
                 best, bsrc, bw = fold(best, bsrc, bw, cand,
-                                      iy[sli][None], pg.delay_x)
+                                      iy[sl], gm.delay_x)
                 for p in (0, 1):
                     if (1 + p) % W == 0:
                         continue
                     r_src = jnp.roll(src_c, -(1 + p), axis=1)[sl]
-                    r_i = jnp.roll(iy, -(1 + p), axis=0)[sli][None]
+                    r_i = jnp.roll(iy, -(1 + p), axis=1)[sl]
                     cand = jnp.where(tgt_gate, r_src, INF)
-                    cand = cand + crit_c * pg.delay_x + cc_x
-                    cand = jnp.where(par[None, None] == p, cand, INF)
+                    cand = cand + crit_c * gm.delay_x + cc_x
+                    cand = jnp.where(par[:, None] == p, cand, INF)
                     best, bsrc, bw = fold(best, bsrc, bw, cand, r_i,
-                                          pg.delay_x)
+                                          gm.delay_x)
         return best, bsrc, bw
 
     for a_off in (0, 1):
-        tgt_gate = pg.last_x if a_off == 0 else pg.first_x
-        par = ((jnp.arange(1, NX + 1)[:, None] - a_off) + yg) % 2
+        tgt_gate = gm.last_x if a_off == 0 else gm.first_x
+        par = gm.base_par[:, 1 - a_off:1 - a_off + NX, :]
         for b_off in (0, 1):
             src_gated = cy_last if b_off == 0 else cy_first
             sl = (slice(None), slice(None),
                   slice(1 - a_off, 1 - a_off + NX),
                   slice(b_off, b_off + NY + 1))
-            sli = (slice(None),) + sl[2:]
             v_any = cy_all[sl]
             v_src = src_gated[sl]
-            src_i = iy[sli][None]
+            src_i = iy[sl]
             cand = jnp.minimum(v_src, jnp.where(tgt_gate, v_any, INF))
-            cand = cand + crit_c * pg.delay_x + cc_x
-            best, bsrc, bw = fold(best, bsrc, bw, cand, src_i, pg.delay_x)
+            cand = cand + crit_c * gm.delay_x + cc_x
+            best, bsrc, bw = fold(best, bsrc, bw, cand, src_i, gm.delay_x)
             for p in (0, 1):
                 if (1 + p) % W == 0:
                     continue
                 r_all = jnp.roll(cy_all, -(1 + p), axis=1)[sl]
                 r_src = jnp.roll(src_gated, -(1 + p), axis=1)[sl]
-                r_i = jnp.roll(iy, -(1 + p), axis=0)[sli][None]
+                r_i = jnp.roll(iy, -(1 + p), axis=1)[sl]
                 cand = jnp.minimum(r_src, jnp.where(tgt_gate, r_all, INF))
-                cand = cand + crit_c * pg.delay_x + cc_x
-                cand = jnp.where(par[None, None] == p, cand, INF)
+                cand = cand + crit_c * gm.delay_x + cc_x
+                cand = jnp.where(par[:, None] == p, cand, INF)
                 best, bsrc, bw = fold(best, bsrc, bw, cand, r_i,
-                                      pg.delay_x)
+                                      gm.delay_x)
     return best, bsrc, bw
 
 
-def _sweep_costs(pg: PlanesGraph, crit_c, cc_x, cc_y):
+def _sweep_costs(gm: PlanesGeom, crit_c, cc_x, cc_y):
     """Scan step costs: pay switch delay + congestion only at span
     breaks.  Unidir: a forward (increasing-coordinate) scan may cross a
     break only on INC tracks, a backward scan only on DEC tracks —
     crossing against a wire's direction is blocked (INF).  Within-span
     motion stays free in both scans (the span is one node)."""
-    cost_x = crit_c * pg.delay_x + cc_x
-    cost_y = crit_c * pg.delay_y + cc_y
-    if pg.directional:
-        inc = pg.inc_track[:, None, None]
-        cfx = jnp.where(pg.brk_before_x, jnp.where(inc, cost_x, INF), 0.0)
-        cbx = jnp.where(pg.brk_after_x, jnp.where(inc, INF, cost_x), 0.0)
-        cfy = jnp.where(pg.brk_before_y, jnp.where(inc, cost_y, INF), 0.0)
-        cby = jnp.where(pg.brk_after_y, jnp.where(inc, INF, cost_y), 0.0)
+    cost_x = crit_c * gm.delay_x + cc_x
+    cost_y = crit_c * gm.delay_y + cc_y
+    if gm.directional:
+        inc = gm.inc_track[:, None, None]
+        cfx = jnp.where(gm.brk_before_x, jnp.where(inc, cost_x, INF), 0.0)
+        cbx = jnp.where(gm.brk_after_x, jnp.where(inc, INF, cost_x), 0.0)
+        cfy = jnp.where(gm.brk_before_y, jnp.where(inc, cost_y, INF), 0.0)
+        cby = jnp.where(gm.brk_after_y, jnp.where(inc, INF, cost_y), 0.0)
     else:
-        cfx = jnp.where(pg.brk_before_x, cost_x, 0.0)
-        cbx = jnp.where(pg.brk_after_x, cost_x, 0.0)
-        cfy = jnp.where(pg.brk_before_y, cost_y, 0.0)
-        cby = jnp.where(pg.brk_after_y, cost_y, 0.0)
-    wfx = jnp.where(pg.brk_before_x, pg.delay_x, 0.0)
-    wbx = jnp.where(pg.brk_after_x, pg.delay_x, 0.0)
-    wfy = jnp.where(pg.brk_before_y, pg.delay_y, 0.0)
-    wby = jnp.where(pg.brk_after_y, pg.delay_y, 0.0)
+        cfx = jnp.where(gm.brk_before_x, cost_x, 0.0)
+        cbx = jnp.where(gm.brk_after_x, cost_x, 0.0)
+        cfy = jnp.where(gm.brk_before_y, cost_y, 0.0)
+        cby = jnp.where(gm.brk_after_y, cost_y, 0.0)
+    wfx = jnp.where(gm.brk_before_x, gm.delay_x, 0.0)
+    wbx = jnp.where(gm.brk_after_x, gm.delay_x, 0.0)
+    wfy = jnp.where(gm.brk_before_y, gm.delay_y, 0.0)
+    wby = jnp.where(gm.brk_after_y, gm.delay_y, 0.0)
     return cfx, cbx, cfy, cby, wfx, wbx, wfy, wby
 
 
-def _sweep_once(pg: PlanesGraph, s, crit_c, cc_x, cc_y, costs,
-                idxx, idxy):
+def _sweep_once(gm: PlanesGeom, s, crit_c, cc_x, cc_y, costs):
     """One relaxation sweep (2 x-scans, turn into y, 2 y-scans, turn
     into x) over the (dist, pred, wenter) state — THE shared body of
-    the XLA program (planes_relax) and the Pallas VMEM-resident kernel
-    (planes_pallas.py)."""
+    the XLA programs (planes_relax / planes_relax_cropped) and the
+    Pallas VMEM-resident kernel (planes_pallas.py).  Scan-neighbor
+    strides use gm.stride_x (the GLOBAL flat-index stride), so pred
+    payloads stay in global cell-id space under cropping."""
     cfx, cbx, cfy, cby, wfx, wbx, wfy, wby = costs
-    _, NX, NYp1 = pg.shape_x
     dx, dy, predx, predy, wx, wy = s
-    dx, predx, wx = _scan_update(dx, predx, wx, cfx, wfx, idxx[None],
-                                 NYp1, 2, False)
-    dx, predx, wx = _scan_update(dx, predx, wx, cbx, wbx, idxx[None],
-                                 NYp1, 2, True)
-    tv, ts, tw = _turn_triples_into_y(pg, dx, idxx, crit_c, cc_y)
+    dx, predx, wx = _scan_update(dx, predx, wx, cfx, wfx, gm.idxx,
+                                 gm.stride_x, 2, False)
+    dx, predx, wx = _scan_update(dx, predx, wx, cbx, wbx, gm.idxx,
+                                 gm.stride_x, 2, True)
+    tv, ts, tw = _turn_triples_into_y(gm, dx, crit_c, cc_y)
     imp = tv < dy
     dy = jnp.where(imp, tv, dy)
     predy = jnp.where(imp, ts, predy)
     wy = jnp.where(imp, tw, wy)
-    dy, predy, wy = _scan_update(dy, predy, wy, cfy, wfy, idxy[None],
+    dy, predy, wy = _scan_update(dy, predy, wy, cfy, wfy, gm.idxy,
                                  1, 3, False)
-    dy, predy, wy = _scan_update(dy, predy, wy, cby, wby, idxy[None],
+    dy, predy, wy = _scan_update(dy, predy, wy, cby, wby, gm.idxy,
                                  1, 3, True)
-    tv, ts, tw = _turn_triples_into_x(pg, dy, idxy, crit_c, cc_x)
+    tv, ts, tw = _turn_triples_into_x(gm, dy, crit_c, cc_x)
     imp = tv < dx
     dx = jnp.where(imp, tv, dx)
     predx = jnp.where(imp, ts, predx)
@@ -749,21 +849,16 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     cc_x = cshard(cc_flat[:, :ncx].reshape(B, W, NX, NYp1))
     cc_y = cshard(cc_flat[:, ncx:].reshape(B, W, NXp1, NY))
 
-    idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(W, NX, NYp1)
-    idxy = (ncx + jnp.arange(W * NXp1 * NY, dtype=jnp.int32)
-            ).reshape(W, NXp1, NY)
-    predx = jnp.broadcast_to(idxx[None], dx.shape)
-    predy = jnp.broadcast_to(idxy[None], dy.shape)
+    gm = geom_full(pg)
+    predx = jnp.broadcast_to(gm.idxx, dx.shape)
+    predy = jnp.broadcast_to(gm.idxy, dy.shape)
     wx = wenter0[:, :ncx].reshape(B, W, NX, NYp1)
     wy = wenter0[:, ncx:].reshape(B, W, NXp1, NY)
 
-    cfx, cbx, cfy, cby, wfx, wbx, wfy, wby = _sweep_costs(
-        pg, crit_c, cc_x, cc_y)
+    costs = _sweep_costs(gm, crit_c, cc_x, cc_y)
 
     def sweep(_, s):
-        s = _sweep_once(pg, s, crit_c, cc_x, cc_y,
-                        (cfx, cbx, cfy, cby, wfx, wbx, wfy, wby),
-                        idxx, idxy)
+        s = _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
         # keep the loop-carried canvases pinned to the mesh layout so
         # GSPMD doesn't migrate them between sweeps
         return tuple(cshard(t) for t in s)
@@ -776,6 +871,80 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
                                axis=1)
 
     return flat(dx, dy), flat(predx, predy), flat(wx, wy)
+
+
+def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
+                         wenter0, nsweeps: int, ox, oy,
+                         cnx: int, cny: int):
+    """planes_relax on per-net (cnx, cny) CROPPED canvases: net b sweeps
+    only the tile starting at grid cell (ox[b], oy[b]) — work per net
+    scales with its bounding box, not the device (the reference's
+    per-net bb, route.h:70-165, realized as a static crop).
+
+    EXACT under the caller contract: every finite-cc cell of net b (the
+    bb mask plus bb-INTERSECTING wires whose spans overhang the box)
+    and every seeded cell of d0 lies inside the tile — expand the bb by
+    (max wire length - 1) and clamp origins to the grid.  Cells outside
+    the tile return their d0 / self-pred / wenter0 unchanged (they are
+    unreachable in the full program too: their cc is INF).
+
+    Same (dist, pred, wenter) flat returns as planes_relax."""
+    B = d0_flat.shape[0]
+    W, NX, NYp1 = pg.shape_x
+    _, NXp1, NY = pg.shape_y
+    ncx = W * NX * NYp1
+
+    gm_full = geom_full(pg)
+    gm = geom_cropped(pg, ox, oy, cnx, cny, full=gm_full)
+
+    def crop4(a, xs, ys):
+        return jax.vmap(lambda t, x0, y0: lax.dynamic_slice(
+            t, (0, x0, y0), (W, xs, ys)))(a, ox, oy)
+
+    dxf = d0_flat[:, :ncx].reshape(B, W, NX, NYp1)
+    dyf = d0_flat[:, ncx:].reshape(B, W, NXp1, NY)
+    ccxf = cc_flat[:, :ncx].reshape(B, W, NX, NYp1)
+    ccyf = cc_flat[:, ncx:].reshape(B, W, NXp1, NY)
+    wxf = wenter0[:, :ncx].reshape(B, W, NX, NYp1)
+    wyf = wenter0[:, ncx:].reshape(B, W, NXp1, NY)
+
+    dx = crop4(dxf, cnx, cny + 1)
+    dy = crop4(dyf, cnx + 1, cny)
+    cc_x = crop4(ccxf, cnx, cny + 1)
+    cc_y = crop4(ccyf, cnx + 1, cny)
+    wx = crop4(wxf, cnx, cny + 1)
+    wy = crop4(wyf, cnx + 1, cny)
+    predx = jnp.broadcast_to(gm.idxx, dx.shape)
+    predy = jnp.broadcast_to(gm.idxy, dy.shape)
+
+    costs = _sweep_costs(gm, crit_c, cc_x, cc_y)
+
+    def sweep(_, s):
+        return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
+
+    dx, dy, predx, predy, wx, wy = lax.fori_loop(
+        0, nsweeps, sweep, (dx, dy, predx, predy, wx, wy))
+
+    # scatter the tiles back into the full canvases (one full-canvas
+    # write per relaxation instead of ~15 traversals per sweep)
+    def put(full, tile):
+        return jax.vmap(lambda f, t, x0, y0: lax.dynamic_update_slice(
+            f, t, (0, x0, y0)))(full, tile, ox, oy)
+
+    idxx_f = jnp.broadcast_to(gm_full.idxx, dxf.shape)
+    idxy_f = jnp.broadcast_to(gm_full.idxy, dyf.shape)
+    dxo = put(dxf, dx)
+    dyo = put(dyf, dy)
+    pxo = put(idxx_f, predx)
+    pyo = put(idxy_f, predy)
+    wxo = put(wxf, wx)
+    wyo = put(wyf, wy)
+
+    def flat(a, b):
+        return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
+                               axis=1)
+
+    return flat(dxo, dyo), flat(pxo, pyo), flat(wxo, wyo)
 
 
 # ---------------------------------------------------------------------------
@@ -793,7 +962,8 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                direct_oidx_all, direct_ipin_all, direct_delay_all,
                sel, valid, force, full_bb,
                nsweeps: int, max_len: int, num_waves: int, group: int,
-               doubling: bool, mesh, use_pallas: bool = False):
+               doubling: bool, mesh, use_pallas: bool = False,
+               crop_tile=None, bb0_all=None):
     """One fused batch step (traceable body shared by the standalone
     per-batch wrapper and the window program): rip up the selected nets,
     re-route each against the occupancy view of everyone-but-itself with
@@ -886,6 +1056,26 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
     # initial tree: empty in cell space; SOURCE entries come via opin_du
     seed0 = jnp.zeros((B, ncells), bool)
 
+    # per-net crop origins (static (cnx, cny) tile, route.h:70-165 bb
+    # semantics as a crop): anchored on the net's STATIC INITIAL bb
+    # (bb0_all — terminal extent + bb_factor), NOT the live bb, so a
+    # net whose bb widened device-side (unreached sink -> full_bb)
+    # keeps a tile that COVERS ALL ITS TERMINALS and stays routable —
+    # its search is tile-clamped until the host re-classifies it into
+    # the full-canvas window at the next sync (the dev_wide summary
+    # output).  The tile covers every bb0-intersecting wire (margin
+    # max_span)
+    if crop_tile is not None:
+        cnx_t, cny_t = crop_tile
+        NXg = pg.shape_x[1]
+        NYg = pg.shape_y[2]
+        Lm = pg.max_span
+        bb_anchor = bb0_all[sel] if bb0_all is not None else b_bb
+        crop_ox = jnp.clip(bb_anchor[:, 0] - Lm, 0, NXg - cnx_t
+                           ).astype(jnp.int32)
+        crop_oy = jnp.clip(bb_anchor[:, 2] - Lm, 0, NYg - cny_t
+                           ).astype(jnp.int32)
+
     def wave_body(wave, state):
         (seed_cells, tdel_cells, opin_used, remaining, wpaths, delay,
          reached_all) = state
@@ -925,6 +1115,10 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
             from .planes_pallas import planes_relax_pallas
             dist, pred, wenter = planes_relax_pallas(
                 pg, d0, cc_flat, crit_c, wenter0, nsweeps)
+        elif crop_tile is not None:
+            dist, pred, wenter = planes_relax_cropped(
+                pg, d0, cc_flat, crit_c, wenter0, nsweeps,
+                crop_ox, crop_oy, cnx_t, cny_t)
         else:
             dist, pred, wenter = planes_relax(pg, d0, cc_flat, crit_c,
                                               wenter0, nsweeps, mesh)
@@ -1125,7 +1319,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
 @functools.partial(
     jax.jit,
     static_argnames=("nsweeps", "max_len", "num_waves", "group",
-                     "doubling", "mesh", "use_pallas"),
+                     "doubling", "mesh", "use_pallas", "crop_tile"),
     donate_argnames=("occ", "paths", "sink_delay", "all_reached", "bb"))
 def route_batch_resident_planes(
         pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
@@ -1136,7 +1330,8 @@ def route_batch_resident_planes(
         direct_oidx_all, direct_ipin_all, direct_delay_all,
         sel, valid, full_bb,
         nsweeps: int, max_len: int, num_waves: int, group: int,
-        doubling: bool = False, mesh=None, use_pallas: bool = False):
+        doubling: bool = False, mesh=None, use_pallas: bool = False,
+        crop_tile=None, bb0_all=None):
     """Standalone one-batch wrapper of _step_core (resident-state
     contract of search.route_batch_resident; the host picked the nets,
     so force=True)."""
@@ -1147,7 +1342,8 @@ def route_batch_resident_planes(
         sink_uid_all, uid_cell, uid_ipin, uid_delay,
         direct_oidx_all, direct_ipin_all, direct_delay_all,
         sel, valid, jnp.bool_(True), full_bb,
-        nsweeps, max_len, num_waves, group, doubling, mesh, use_pallas)
+        nsweeps, max_len, num_waves, group, doubling, mesh, use_pallas,
+        crop_tile, bb0_all)
     return (paths, sink_delay, all_reached, bb, occ,
             jnp.int32(nsweeps * num_waves))
 
@@ -1197,7 +1393,7 @@ def _mis_colors(dev: DeviceRRGraph, occ, paths, all_reached,
     static_argnames=("K_iters", "nsweeps", "max_len", "num_waves",
                      "group", "doubling", "topk", "n_colors", "mesh",
                      "sta_depth", "crit_exp", "max_crit", "use_sdc",
-                     "use_pallas"),
+                     "use_pallas", "crop_tile"),
     donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
                      "bb", "crit_all"))
 def route_window_planes(
@@ -1214,7 +1410,8 @@ def route_window_planes(
         n_colors: int = 5, mesh=None,
         tdev=None, req_seed=None, sta_depth: int = 0,
         crit_exp: float = 1.0, max_crit: float = 0.99,
-        use_sdc: bool = False, use_pallas: bool = False):
+        use_sdc: bool = False, use_pallas: bool = False,
+        crop_tile=None, bb0_all=None):
     """A WINDOW of K_iters complete PathFinder iterations as ONE device
     program: per iteration, every batch group in sel_plan [G, B] runs the
     fused rip-up/route/commit step (clean nets no-op via the device-side
@@ -1262,7 +1459,7 @@ def route_window_planes(
                     direct_oidx_all, direct_ipin_all, direct_delay_all,
                     sel_plan[g], valid_plan[g], force, full_bb,
                     nsweeps, max_len, num_waves, group, doubling, mesh,
-                    use_pallas)
+                    use_pallas, crop_tile, bb0_all)
                 return (occ2, paths2, sink_delay2, all_reached2, bb2,
                         nr + n_act, ng + 1)
 
@@ -1314,7 +1511,13 @@ def route_window_planes(
     # paths array when a device-side widening outgrew it
     span = (bb[:, 1] - bb[:, 0]) + (bb[:, 3] - bb[:, 2])
     max_span = jnp.max(jnp.where(rrm, span, 0))
+    # nets whose live bb widened to device scale (unreached-sink
+    # widening inside _step_core): the host folds this into its `wide`
+    # classification so they take the full-canvas window next time
+    NXg = pg.shape_x[1]
+    NYg = pg.shape_y[2]
+    dev_wide = span >= (NXg + NYg)
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
             colors, (over > 0).sum(dtype=jnp.int32),
             over.sum(dtype=jnp.int32), nroutes, nexec, crit_all,
-            dmax_hist, max_span)
+            dmax_hist, max_span, dev_wide)

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .planes import PlanesGraph, _sweep_costs, _sweep_once
+from .planes import PlanesGeom, PlanesGraph, _sweep_costs, _sweep_once
 
 
 def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
@@ -41,21 +41,27 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
                   # outputs
                   odx_ref, ody_ref, opx_ref, opy_ref, owx_ref, owy_ref):
     """One grid step = one net: load canvases into VMEM values, rebuild
-    a PlanesGraph view over the loaded masks, run the shared sweep body
+    a PlanesGeom view over the loaded masks, run the shared sweep body
     nsweeps times, store results."""
     W, NX, NYp1 = pg_template.shape_x
     _, NXp1, NY = pg_template.shape_y
     ncx = W * NX * NYp1
 
-    pg = PlanesGraph(
-        node_of_cell=pg_template.node_of_cell,      # unused by sweeps
-        cell_of_node=pg_template.cell_of_node,
-        brk_before_x=bbx_ref[:] != 0, brk_after_x=bax_ref[:] != 0,
-        brk_before_y=bby_ref[:] != 0, brk_after_y=bay_ref[:] != 0,
-        first_x=fx_ref[:] != 0, last_x=lx_ref[:] != 0,
-        first_y=fy_ref[:] != 0, last_y=ly_ref[:] != 0,
-        delay_x=delx_ref[:], delay_y=dely_ref[:],
-        delay_y_rot0=delr0_ref[:], delay_y_rot1=delr1_ref[:],
+    idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(1, W, NX, NYp1)
+    idxy = (ncx + jnp.arange(W * NXp1 * NY, dtype=jnp.int32)
+            ).reshape(1, W, NXp1, NY)
+    base_par = ((jnp.arange(NX + 1)[:, None]
+                 + jnp.arange(NY + 1)[None, :]) % 2)[None]
+    gm = PlanesGeom(
+        brk_before_x=(bbx_ref[:] != 0)[None],
+        brk_after_x=(bax_ref[:] != 0)[None],
+        brk_before_y=(bby_ref[:] != 0)[None],
+        brk_after_y=(bay_ref[:] != 0)[None],
+        first_x=(fx_ref[:] != 0)[None], last_x=(lx_ref[:] != 0)[None],
+        first_y=(fy_ref[:] != 0)[None], last_y=(ly_ref[:] != 0)[None],
+        delay_x=delx_ref[:][None], delay_y=dely_ref[:][None],
+        delay_y_rot0=delr0_ref[:][None], delay_y_rot1=delr1_ref[:][None],
+        idxx=idxx, idxy=idxy, base_par=base_par, stride_x=NYp1,
         directional=pg_template.directional,
         inc_track=(inc_ref[:] != 0 if pg_template.directional else None),
     )
@@ -68,16 +74,13 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
     wx = wx_ref[:]
     wy = wy_ref[:]
 
-    idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(W, NX, NYp1)
-    idxy = (ncx + jnp.arange(W * NXp1 * NY, dtype=jnp.int32)
-            ).reshape(W, NXp1, NY)
-    predx = jnp.broadcast_to(idxx[None], dx.shape)
-    predy = jnp.broadcast_to(idxy[None], dy.shape)
+    predx = jnp.broadcast_to(gm.idxx, dx.shape)
+    predy = jnp.broadcast_to(gm.idxy, dy.shape)
 
-    costs = _sweep_costs(pg, crit_c, cc_x, cc_y)
+    costs = _sweep_costs(gm, crit_c, cc_x, cc_y)
 
     def body(_, s):
-        return _sweep_once(pg, s, crit_c, cc_x, cc_y, costs, idxx, idxy)
+        return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
     dx, dy, predx, predy, wx, wy = jax.lax.fori_loop(
         0, nsweeps, body, (dx, dy, predx, predy, wx, wy))

@@ -27,6 +27,7 @@ analogue of --num_threads.
 from __future__ import annotations
 
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
@@ -41,6 +42,8 @@ from .device_graph import DeviceRRGraph, to_device
 from .search import (build_windows, conflict_subset, iteration_summary,
                      route_batch_resident, route_batch_resident_win,
                      window_sizes, wirelength_on_device)
+
+_DEBUG_CROP = bool(os.environ.get("PEDA_DEBUG_CROP"))
 
 
 @dataclass
@@ -102,6 +105,12 @@ class RouterOpts:
     # (at window boundaries) into result.checkpoint — the elastic
     # resume surface (RouteCheckpoint; planes program only).  0 = off
     checkpoint_every: int = 0
+    # bb-cropped planes relaxation (route.h:70-165 per-net boxes as a
+    # static crop tile; planes.planes_relax_cropped): "auto" crops a
+    # window whenever the bucketed tile is meaningfully smaller than
+    # the grid, "off" always sweeps full canvases.  Work per net then
+    # scales with its bounding box, not the device
+    crop: str = "auto"
 
 
 @dataclass
@@ -155,6 +164,10 @@ class RouteResult:
     # search effort counters (perf_t analogue, route.h:12-20)
     total_net_routes: int = 0
     total_relax_steps: int = 0
+    # of which: sweeps over bb-CROPPED canvases (tile area, not grid
+    # area — the two cost very different device time; bench projections
+    # need the split)
+    total_relax_steps_cropped: int = 0
     # nets whose bb was widened to the full device (left the windowed
     # program; 0 on a healthy windowed run of a routable circuit)
     widened_nets: int = 0
@@ -492,6 +505,13 @@ class Router:
         full_reroute_done = False
         force_all_next = False
         widx = 0
+        # monotonic crop-tile ratchet: tiles only GROW within one route
+        # call (and stick at full once any window needs it) so the
+        # number of compiled window-program variants stays O(1) — on
+        # the tunneled TPU every new static shape is a remote compile
+        crop_cw = crop_ch = 0
+        crop_full = opts.crop != "auto" or self.mesh is not None \
+            or self.use_pallas
 
         if resume is not None:
             # elastic resume: the checkpointed negotiation continues
@@ -513,6 +533,9 @@ class Router:
             full_reroute_done = d["full_reroute_done"]
             force_all_next = d["force_all_next"]
             result.widened_nets = d["widened_nets"]
+            crop_cw = d.get("crop_cw", 0)
+            crop_ch = d.get("crop_ch", 0)
+            crop_full = d.get("crop_full", crop_full)
 
         L = int(paths.shape[2])          # current path-slot budget
         L_cap = self.max_len
@@ -524,6 +547,12 @@ class Router:
         # compiled-out log macros
         from ..mdclog import MdcLogger
         mlog = MdcLogger(opts.stats_dir)
+        # static initial bbs (terminal extent + bb_factor): the crop
+        # anchor — tiles must cover a net's terminals even after its
+        # LIVE bb widens device-side (see _step_core crop notes)
+        bb0_d = jnp.asarray(np.stack(
+            [term.bb_xmin, term.bb_xmax, term.bb_ymin, term.bb_ymax],
+            axis=1).astype(np.int32))
         while it_done < opts.max_router_iterations:
             K = self._WINDOWS[min(widx, len(self._WINDOWS) - 1)]
             if (timing_cb is not None and analyzer is None) \
@@ -535,60 +564,162 @@ class Router:
             K = min(K, opts.max_router_iterations - it_done)
             widx += 1
 
-            sel_plan, valid_plan = self._plan_groups(
-                dirty, colors, nsinks_np, cx_np, cy_np, B, R)
-            # static loop bounds from the window's work set (planes
-            # sweeps span whole rows; ~#turns+margin suffice, bucketed
-            # to limit compile variants; widening retries are the net)
-            w_sel = np.where(wide[dirty], rr.grid.nx + 2,
+            # per-net spans of the window's work set (host view; nets
+            # the host widened take full-device spans)
+            w_all = np.where(wide[dirty], rr.grid.nx + 2,
                              term.bb_xmax[dirty] - term.bb_xmin[dirty]
                              + 1) if len(dirty) else np.array([8])
-            h_sel = np.where(wide[dirty], rr.grid.ny + 2,
+            h_all = np.where(wide[dirty], rr.grid.ny + 2,
                              term.bb_ymax[dirty] - term.bb_ymin[dirty]
                              + 1) if len(dirty) else np.array([8])
-            span = int((w_sel + h_sel).max()) if len(dirty) else 8
-            # sweep_boost doubles while overuse stalls: a congested
-            # detour can need more turns than the bb-span heuristic
-            # (the fixed-trip relax has no early exit to lean on)
-            nsweeps = min(128, -(-max(8, span * sweep_boost) // 8) * 8)
-            maxfan = int(nsinks_np[dirty].max()) if len(dirty) else 1
-            doubling = opts.sink_group == 0 and not precise
-            grp_w = 1 if precise and opts.sink_group == 0 else grp
-            waves = (max(1, math.ceil(math.log2(maxfan + 1))) + 1
-                     if doubling
-                     else min(Smax, math.ceil(maxfan / grp_w) + 1))
+
+            # bb-crop tile bucket (static per compile): smallest
+            # 8-bucket covering >=90% of the dirty nets + the wire-
+            # overhang margin; nets past it (device-spanning resets,
+            # host-widened boxes) run in a SEPARATE full-canvas window
+            # call — the planes analogue of the ELL path's narrow/wide
+            # group split.  Tiles only grow within one route call (the
+            # compile-variant ratchet); crop is XLA-unsharded-only
+            # (crops are net-local, so the spatial mesh axis and the
+            # per-net Pallas grid keep full canvases)
+            crop_tile = None
+            narrow = np.ones(len(dirty), dtype=bool)
+            if not crop_full and len(dirty):
+                Lm = self.pg.max_span
+                NXg, NYg = rr.grid.nx, rr.grid.ny
+                nD = len(dirty)
+                sw, sh = np.sort(w_all), np.sort(h_all)
+                # per-sweep work proxy: canvas area x sweeps (sweeps
+                # scale with the span); pick the percentile split whose
+                # narrow-cropped + wide-full cost is cheapest, crop only
+                # when it beats all-full by >=20%
+                full_cost = (-(-nD // B)) * NXg * NYg * (NXg + NYg)
+                best_cost = full_cost
+                best = None
+                for pct in (0.5, 0.75, 0.9, 1.0):
+                    q = max(1, int(np.ceil(pct * nD))) - 1
+                    cw = max(crop_cw, min(
+                        NXg, -(-(int(sw[q]) + 2 * Lm) // 8) * 8))
+                    ch = max(crop_ch, min(
+                        NYg, -(-(int(sh[q]) + 2 * Lm) // 8) * 8))
+                    if cw * ch >= NXg * NYg:
+                        continue
+                    nm = ((w_all + 2 * Lm <= cw)
+                          & (h_all + 2 * Lm <= ch))
+                    g_n = -(-int(nm.sum()) // B)
+                    g_w = -(-int(nD - nm.sum()) // B)
+                    cost = (g_n * cw * ch * (cw + ch)
+                            + g_w * NXg * NYg * (NXg + NYg))
+                    if cost < best_cost:
+                        best_cost, best = cost, (cw, ch, nm)
+                if best is not None and best_cost <= 0.8 * full_cost:
+                    crop_cw, crop_ch, narrow = best
+                    crop_tile = (crop_cw, crop_ch)
+                else:
+                    # tiles this close to the grid never pay; stop
+                    # re-evaluating (and recompiling) for this route
+                    crop_full = crop_cw * crop_ch >= NXg * NYg
+            if _DEBUG_CROP:
+                print("DBGCROP", "tile", crop_tile, "narrow",
+                      int(narrow.sum()), "/", len(dirty),
+                      "crop_full", crop_full, flush=True)
+
+            def window_call(sub, tile, esc, pres_in):
+                """One route_window_planes dispatch over the `sub`
+                subset of dirty nets.  esc=False freezes the acc
+                escalation (the narrow call already applied it this
+                window; pres re-escalates identically in both so
+                iteration k sees the same pres)."""
+                sel_p, valid_p = self._plan_groups(
+                    sub, colors, nsinks_np, cx_np, cy_np, B, R)
+                ws = np.where(wide[sub], rr.grid.nx + 2,
+                              term.bb_xmax[sub] - term.bb_xmin[sub]
+                              + 1) if len(sub) else np.array([8])
+                hs = np.where(wide[sub], rr.grid.ny + 2,
+                              term.bb_ymax[sub] - term.bb_ymin[sub]
+                              + 1) if len(sub) else np.array([8])
+                span = int((ws + hs).max()) if len(sub) else 8
+                # sweep_boost doubles while overuse stalls: a congested
+                # detour can need more turns than the bb-span heuristic
+                # (the fixed-trip relax has no early exit to lean on)
+                nsw = min(128, -(-max(8, span * sweep_boost) // 8) * 8)
+                maxfan = int(nsinks_np[sub].max()) if len(sub) else 1
+                doubling = opts.sink_group == 0 and not precise
+                grp_w = 1 if precise and opts.sink_group == 0 else grp
+                waves = (max(1, math.ceil(math.log2(maxfan + 1))) + 1
+                         if doubling
+                         else min(Smax, math.ceil(maxfan / grp_w) + 1))
+                out = route_window_planes(
+                    self.pg, dev, occ, acc, paths, sink_delay,
+                    all_reached, bb, source_d, sinks_d, crit_d,
+                    *planes_tbl,
+                    jnp.asarray(sel_p), jnp.asarray(valid_p), full_bb,
+                    jnp.float32(pres_in),
+                    jnp.float32(opts.pres_fac_mult),
+                    jnp.float32(opts.max_pres_fac),
+                    jnp.float32(opts.acc_fac if esc else 0.0),
+                    jnp.int32(it_done),
+                    jnp.int32(it_done + 1 if force_all_next
+                              else opts.incremental_after),
+                    K, nsw, L, waves, grp_w,
+                    doubling, min(4096, N), 5, self.mesh,
+                    use_pallas=self.use_pallas, crop_tile=tile,
+                    bb0_all=bb0_d, **sta_kw)
+                return out, waves * nsw
 
             t0 = time.time()
-            out = route_window_planes(
-                self.pg, dev, occ, acc, paths, sink_delay, all_reached,
-                bb, source_d, sinks_d, crit_d, *planes_tbl,
-                jnp.asarray(sel_plan), jnp.asarray(valid_plan), full_bb,
-                jnp.float32(pres), jnp.float32(opts.pres_fac_mult),
-                jnp.float32(opts.max_pres_fac),
-                jnp.float32(opts.acc_fac), jnp.int32(it_done),
-                jnp.int32(it_done + 1 if force_all_next
-                          else opts.incremental_after),
-                K, nsweeps, L, waves, grp_w,
-                doubling, min(4096, N), 5, self.mesh,
-                use_pallas=self.use_pallas, **sta_kw)
+            w_steps = 0
+            w_steps_crop = 0
+            nroutes_w = 0
+            nexec_w = 0
+            if crop_tile is not None and not narrow.all():
+                # narrow/cropped first (with escalation), then the wide
+                # remainder on full canvases (esc frozen); the narrow
+                # call's counters are fetched only AFTER the wide call
+                # is dispatched, so the extra host work overlaps the
+                # device instead of serializing a second full sync
+                out1, per_g1 = window_call(dirty[narrow], crop_tile,
+                                           True, pres)
+                occ, acc, paths, sink_delay, all_reached, bb = out1[:6]
+                crit_d = out1[13]
+                out, per_g = window_call(dirty[~narrow], None,
+                                         False, pres)
+                n1, e1 = (int(np.asarray(v)) for v in jax.device_get(
+                    (out1[11], out1[12])))
+                nroutes_w += n1
+                nexec_w += e1
+                w_steps += e1 * per_g1
+                w_steps_crop += e1 * per_g1
+            else:
+                out, per_g = window_call(dirty, crop_tile, True, pres)
             occ, acc, paths, sink_delay, all_reached, bb = out[:6]
             force_all_next = False
             # the ONE sync per window (dmax_hist rides along: the
             # per-iteration crit-path delays from the fused STA;
             # max_span: largest dirty-net bb for path-budget regrowth)
             (rrm, colors, n_over, over_total, nroutes, nexec, dmax_hist,
-             max_span) = (
+             max_span, dev_wide) = (
                 np.asarray(v) for v in jax.device_get(
                     (out[7], out[8], out[9], out[10], out[11],
-                     out[12], out[14], out[15])))
+                     out[12], out[14], out[15], out[16])))
             crit_d = out[13]            # donated in; stays device-resident
+            # fold device-side widening into the host classification:
+            # those nets must take the full-canvas window from now on
+            # (their crop tile covers only their static bb0)
+            wide |= dev_wide
+            bb_full |= dev_wide
             n_over, over_total = int(n_over), int(over_total)
             it_done += K
             # nexec = groups that actually executed on device (pad and
             # clean groups skip), so the step counter reflects real work
-            w_steps = int(nexec) * waves * nsweeps
+            nroutes = nroutes_w + int(nroutes)
+            nexec = nexec_w + int(nexec)
+            w_steps += int(nexec - nexec_w) * per_g
+            if crop_tile is not None and narrow.all():
+                w_steps_crop = w_steps      # single cropped call
             result.total_net_routes += int(nroutes)
             result.total_relax_steps += w_steps
+            result.total_relax_steps_cropped += w_steps_crop
             cpd = float(dmax_hist[K - 1]) if analyzer is not None \
                 else float("nan")
             result.stats.append(RouteStats(
@@ -704,7 +835,9 @@ class Router:
                         sweep_boost=sweep_boost, precise=precise,
                         full_reroute_done=full_reroute_done,
                         force_all_next=force_all_next,
-                        widened_nets=result.widened_nets))
+                        widened_nets=result.widened_nets,
+                        crop_cw=crop_cw, crop_ch=crop_ch,
+                        crop_full=crop_full))
                 next_ckpt = it_done + opts.checkpoint_every
                 mlog.log("elastic", event="checkpoint",
                          it_done=it_done, pres=round(pres, 4))

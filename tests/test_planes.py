@@ -135,3 +135,111 @@ def test_planes_incremental_sink_schedule():
     assert rd.success and r1.success
     check_route(f.rr, f.term, r1.paths, occ=r1.occ)
     assert r1.wirelength <= rd.wirelength * 1.05 + 5
+
+
+@pytest.mark.parametrize("unidir,seed", [(False, 3), (True, 5)])
+def test_planes_cropped_matches_full(unidir, seed):
+    """planes_relax_cropped == planes_relax EXACTLY (dist, pred, wenter)
+    when every finite-cc cell and every seed of each net lies inside its
+    crop tile — the per-net bb crop contract (route.h:70-165 semantics;
+    exactness argument in planes.py geom_cropped)."""
+    import jax
+
+    from parallel_eda_tpu.arch.builtin import unidir_arch
+    from parallel_eda_tpu.route.planes import planes_relax_cropped
+
+    if unidir:
+        arch = unidir_arch(chan_width=8)
+        arch.segments = [
+            SegmentInf(name="l1", length=1, frequency=0.5, wire_switch=0,
+                       opin_switch=1, directionality="unidir"),
+            SegmentInf(name="l2", length=2, frequency=0.5, Rmetal=80.0,
+                       Cmetal=15e-15, wire_switch=1, opin_switch=1,
+                       directionality="unidir"),
+        ]
+    else:
+        arch = _mixed_len_arch()
+    # grid comfortably larger than the 3x3-bb tiles so the crop is a
+    # REAL sub-tile (the test asserts that below), not the whole grid
+    grid = DeviceGrid(14, 12, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    pg = build_planes(rr)
+    N = rr.num_nodes
+    W, NX, NYp1 = pg.shape_x
+    _, NXp1, NY = pg.shape_y
+    ncx = W * NX * NYp1
+    B = 4
+    rng = np.random.default_rng(seed)
+
+    # per-net bb (grid coords) + inside mask = bb-INTERSECTING wires
+    bbs = []
+    for b in range(B):
+        x0 = int(rng.integers(1, NX - 2))
+        y0 = int(rng.integers(1, NY - 2))
+        bbs.append((x0, min(NX, x0 + 3), y0, min(NY, y0 + 3)))
+    inside = np.zeros((B, N), bool)
+    for b, (x0, x1, y0, y1) in enumerate(bbs):
+        inside[b] = ((rr.xhigh >= x0) & (rr.xlow <= x1)
+                     & (rr.yhigh >= y0) & (rr.ylow <= y1)
+                     & ((rr.node_type == CHANX) | (rr.node_type == CHANY)))
+    cong = rng.uniform(0.5, 2.0, (B, N)).astype(np.float32) * 1e-10
+    crit = rng.uniform(0.0, 0.9, (B, 1)).astype(np.float32)
+    cong_m = np.where(inside, (1 - crit) * cong, np.inf).astype(np.float32)
+
+    noc = np.asarray(pg.node_of_cell)
+    cc_cells = cong_m[:, noc]                       # [B, ncells]
+
+    # seeds: 2 random finite-cc cells per net
+    d0 = np.full((B, pg.ncells), np.inf, np.float32)
+    for b in range(B):
+        fin = np.where(np.isfinite(cc_cells[b]))[0]
+        d0[b, rng.choice(fin, 2, replace=False)] = 0.0
+
+    # crop tiles from the finite-cc cells (per net, in plane-index
+    # space), bucketed to one static (cnx, cny) for the batch
+    finx = np.isfinite(cc_cells[:, :ncx]).reshape(B, W, NX, NYp1)
+    finy = np.isfinite(cc_cells[:, ncx:]).reshape(B, W, NXp1, NY)
+    ox = np.zeros(B, np.int32)
+    oy = np.zeros(B, np.int32)
+    need_x = need_y = 1
+    for b in range(B):
+        ax = np.where(finx[b].any(axis=(0, 2)))[0]
+        ay = np.where(finx[b].any(axis=(0, 1)))[0]
+        bx = np.where(finy[b].any(axis=(0, 2)))[0]
+        by = np.where(finy[b].any(axis=(0, 1)))[0]
+        o_x = min(ax.min(initial=NX), bx.min(initial=NX))
+        o_y = min(ay.min(initial=NYp1), by.min(initial=NY))
+        ox[b], oy[b] = o_x, o_y
+        need_x = max(need_x, ax.max(initial=0) - o_x + 1,
+                     bx.max(initial=0) - o_x)
+        need_y = max(need_y, ay.max(initial=0) - o_y,
+                     by.max(initial=0) - o_y + 1)
+    cnx = min(NX, int(need_x) + 1)
+    cny = min(NY, int(need_y) + 1)
+    assert cnx < NX and cny < NY, "crop degenerated to the full grid"
+    ox = np.minimum(ox, NX - cnx).astype(np.int32)
+    oy = np.minimum(oy, NY - cny).astype(np.int32)
+
+    crit_c = jnp.asarray(crit)[:, :, None, None]
+    w0 = jnp.zeros((B, pg.ncells), jnp.float32)
+    full = planes_relax(pg, jnp.asarray(d0), jnp.asarray(cc_cells),
+                        crit_c, w0, 64)
+    crop = planes_relax_cropped(
+        pg, jnp.asarray(d0), jnp.asarray(cc_cells), crit_c, w0, 64,
+        jnp.asarray(ox), jnp.asarray(oy), cnx, cny)
+    # The crop changes the associative-scan TREE SHAPE (row length cnx
+    # vs NX), so multi-hop prefix sums can differ by an ulp — bit
+    # equality is not the contract (each program is individually
+    # deterministic; sharded==single stays bit-exact per program).
+    # Contract: identical reachability, values to fp32 roundoff, and
+    # identical pred/wenter except at ulp-tied cells.
+    df, dc = np.asarray(full[0]), np.asarray(crop[0])
+    assert np.array_equal(np.isfinite(df), np.isfinite(dc))
+    fin = np.isfinite(df)
+    np.testing.assert_allclose(dc[fin], df[fin], rtol=1e-5, atol=0)
+    pf, pc = np.asarray(full[1]), np.asarray(crop[1])
+    wf, wc = np.asarray(full[2]), np.asarray(crop[2])
+    mism = (pf != pc) | (wf != wc)
+    assert mism.mean() < 1e-3, mism.mean()
+    # every structural mismatch sits on an ulp-tied distance
+    assert np.allclose(df[mism], dc[mism], rtol=1e-5), "non-tie pred diff"
