@@ -30,8 +30,13 @@ def main():
     ap.add_argument("--big", type=int, default=1200,
                     help="LUTs for the end-to-end route")
     ap.add_argument("--curve_only", action="store_true")
+    ap.add_argument("--memory_only", action="store_true",
+                    help="print only the memory model (small fixture, "
+                         "Titan-proxy extrapolation); no routing")
     ap.add_argument("--batch", type=int, default=64)
     args = ap.parse_args()
+    if args.curve_only and args.memory_only:
+        ap.error("--curve_only and --memory_only are mutually exclusive")
 
     import jax
 
@@ -46,12 +51,15 @@ def main():
     from parallel_eda_tpu.rr.grid import DeviceGrid
 
     # ---- 1. per-sweep cost vs N ----
-    print("## Planes relaxation: per-sweep cost vs rr-graph size\n")
-    print("| grid | W | rr nodes | cells | sweep cost (B=64) |")
-    print("|---|---|---|---|---|")
+    sizes = (() if args.memory_only else
+             ((8, 10), (16, 12), (32, 14), (48, 16), (64, 16),
+              (96, 20)))
+    if sizes:
+        print("## Planes relaxation: per-sweep cost vs rr-graph size\n")
+        print("| grid | W | rr nodes | cells | sweep cost (B=64) |")
+        print("|---|---|---|---|---|")
     B = 64
-    for g, W in ((8, 10), (16, 12), (32, 14), (48, 16), (64, 16),
-                 (96, 20)):
+    for g, W in sizes:
         arch = minimal_arch(chan_width=W)
         rr = build_rr_graph(arch, DeviceGrid(g, g, arch.io_capacity))
         pg = P.build_planes(rr)
@@ -83,38 +91,43 @@ def main():
     from parallel_eda_tpu.place import PlacerOpts
     from parallel_eda_tpu.route import RouterOpts
 
-    print("\n## End-to-end large route\n")
-    t0 = time.time()
-    f = synth_flow(num_luts=args.big, num_inputs=32, num_outputs=32,
-                   chan_width=16, seed=5)
-    log(f"prepared: {f.rr.num_nodes} rr nodes, "
-        f"{f.term.num_nets} nets, grid {f.rr.grid.nx}x{f.rr.grid.ny} "
-        f"({time.time()-t0:.0f}s)")
-    t0 = time.time()
-    f = run_place(f, PlacerOpts(moves_per_step=256), timing_driven=False)
-    t_place = time.time() - t0
-    log(f"placed in {t_place:.0f}s")
-    t0 = time.time()
-    f = run_route(f, RouterOpts(batch_size=args.batch),
-                  timing_driven=False)
-    t_route = time.time() - t0
-    res = f.route
-    R, S = f.term.sinks.shape
-    print(f"- circuit: {args.big} LUTs, {R} nets (Smax {S}), "
-          f"grid {f.rr.grid.nx}x{f.rr.grid.ny} W={f.rr.chan_width}, "
-          f"**{f.rr.num_nodes} rr nodes**")
-    print(f"- route: success={res.success} in {res.iterations} "
-          f"iterations, wirelength {res.wirelength}, "
-          f"{t_route:.0f}s wall ({'tpu' if args.tpu else 'cpu'} backend), "
-          f"{res.total_net_routes} net-routes "
-          f"({res.total_net_routes/t_route:.1f} nets/s)")
-    print(f"- legality: verified by the independent checker (run_route)")
-    print("- iteration stats (window syncs):")
-    print("  | iter | overused | overuse total | dirty nets |")
-    print("  |---|---|---|---|")
-    for s in res.stats:
-        print(f"  | {s.iteration} | {s.overused_nodes} | "
-              f"{s.overuse_total} | {s.rerouted_nets} |")
+    if args.memory_only:
+        f = synth_flow(num_luts=120, num_inputs=16, num_outputs=16,
+                       chan_width=16, seed=5)
+        R, S = f.term.sinks.shape
+    else:
+        print("\n## End-to-end large route\n")
+        t0 = time.time()
+        f = synth_flow(num_luts=args.big, num_inputs=32, num_outputs=32,
+                       chan_width=16, seed=5)
+        log(f"prepared: {f.rr.num_nodes} rr nodes, "
+            f"{f.term.num_nets} nets, grid {f.rr.grid.nx}x{f.rr.grid.ny} "
+            f"({time.time()-t0:.0f}s)")
+        t0 = time.time()
+        f = run_place(f, PlacerOpts(moves_per_step=256), timing_driven=False)
+        t_place = time.time() - t0
+        log(f"placed in {t_place:.0f}s")
+        t0 = time.time()
+        f = run_route(f, RouterOpts(batch_size=args.batch),
+                      timing_driven=False)
+        t_route = time.time() - t0
+        res = f.route
+        R, S = f.term.sinks.shape
+        print(f"- circuit: {args.big} LUTs, {R} nets (Smax {S}), "
+              f"grid {f.rr.grid.nx}x{f.rr.grid.ny} W={f.rr.chan_width}, "
+              f"**{f.rr.num_nodes} rr nodes**")
+        print(f"- route: success={res.success} in {res.iterations} "
+              f"iterations, wirelength {res.wirelength}, "
+              f"{t_route:.0f}s wall ({'tpu' if args.tpu else 'cpu'} backend), "
+              f"{res.total_net_routes} net-routes "
+              f"({res.total_net_routes/t_route:.1f} nets/s)")
+        print(f"- legality: verified by the independent checker (run_route)")
+        print("- iteration stats (window syncs):")
+        print("  | iter | overused | overuse total | dirty nets |")
+        print("  |---|---|---|---|")
+        for s in res.stats:
+            print(f"  | {s.iteration} | {s.overused_nodes} | "
+                  f"{s.overuse_total} | {s.rerouted_nets} |")
 
     # ---- 3. memory model ----
     from parallel_eda_tpu.route.planes import (build_planes,
@@ -193,6 +206,20 @@ def main():
           f"{R_t*S_t*K_t*12/1e9:.1f} GB for sink tables alone plus a "
           f"device-half-perimeter L of {L_dev} "
           f"({R_t*S_t*L_dev*4/1e9:.1f} GB paths).")
+    # bb-cropped windows (planes_relax_cropped): the per-batch search
+    # state is the TILE, not the grid — for bb-local nets (tile ~64x64
+    # on the 300x300 proxy) the 4 per-batch terms above shrink by the
+    # tile-area ratio; only the wide-net window still allocates
+    # grid-sized canvases
+    tile = 64
+    nc_tile = 2 * W_t * tile * (tile + 1)
+    crop_state = 4 * Bt * nc_tile * 4
+    full_state = 4 * Bt * nc_t * 4
+    print(f"\nWith bb-cropped windows (tile {tile}x{tile}), the "
+          f"per-batch planes state is {crop_state/1e9:.2f} GB instead "
+          f"of {full_state/1e9:.2f} GB ({nc_tile/nc_t:.1%} of the "
+          f"canvas) — HBM stops being the batch-size ceiling for the "
+          f"bb-local net population.")
 
 
 if __name__ == "__main__":

@@ -42,3 +42,25 @@ def test_serial_sa_deterministic():
     b = serial_sa_place(flow.pnl, flow.grid, flow.pos, seed=42)
     assert np.array_equal(a.pos, b.pos)
     assert a.proposed == b.proposed and a.accepted == b.accepted
+
+
+def test_run_place_native_refreshes_terminals():
+    """flow.run_place_native must anneal AND re-derive net terminals
+    (the position/terminal invariant run_place owns)."""
+    import numpy as np
+
+    from parallel_eda_tpu.flow import run_place_native, synth_flow
+
+    f = synth_flow(num_luts=60, chan_width=12, seed=9)
+    bb0 = np.asarray(f.term.bb_xmin).copy(), np.asarray(f.term.bb_xmax).copy()
+    pos0 = f.pos.copy()
+    f = run_place_native(f)
+    assert not np.array_equal(f.pos, pos0), "anneal did not move anything"
+    # terminals re-derived for the new positions: bb sums must change
+    bb1 = np.asarray(f.term.bb_xmin), np.asarray(f.term.bb_xmax)
+    assert (not np.array_equal(bb0[0], bb1[0])
+            or not np.array_equal(bb0[1], bb1[1]))
+    # deterministic
+    g = synth_flow(num_luts=60, chan_width=12, seed=9)
+    g = run_place_native(g)
+    assert np.array_equal(f.pos, g.pos)
