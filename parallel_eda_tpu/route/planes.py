@@ -873,6 +873,58 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     return flat(dx, dy), flat(predx, predy), flat(wx, wy)
 
 
+def crop_state(pg: PlanesGraph, d0_flat, cc_flat, wenter0, ox, oy,
+               cnx: int, cny: int):
+    """Shared crop scaffolding of the two cropped programs (XLA and
+    Pallas): reshape the [B, Ncells] flats into canvases and slice each
+    net's (cnx, cny) tile at its origin.  Returns (full canvases
+    (dxf, dyf, wxf, wyf), tiles (dx, dy, ccx, ccy, wx, wy))."""
+    B = d0_flat.shape[0]
+    W, NX, NYp1 = pg.shape_x
+    _, NXp1, NY = pg.shape_y
+    ncx = W * NX * NYp1
+
+    def crop4(a, xs, ys):
+        return jax.vmap(lambda t, x0, y0: lax.dynamic_slice(
+            t, (0, x0, y0), (W, xs, ys)))(a, ox, oy)
+
+    dxf = d0_flat[:, :ncx].reshape(B, W, NX, NYp1)
+    dyf = d0_flat[:, ncx:].reshape(B, W, NXp1, NY)
+    ccxf = cc_flat[:, :ncx].reshape(B, W, NX, NYp1)
+    ccyf = cc_flat[:, ncx:].reshape(B, W, NXp1, NY)
+    wxf = wenter0[:, :ncx].reshape(B, W, NX, NYp1)
+    wyf = wenter0[:, ncx:].reshape(B, W, NXp1, NY)
+    return ((dxf, dyf, wxf, wyf),
+            (crop4(dxf, cnx, cny + 1), crop4(dyf, cnx + 1, cny),
+             crop4(ccxf, cnx, cny + 1), crop4(ccyf, cnx + 1, cny),
+             crop4(wxf, cnx, cny + 1), crop4(wyf, cnx + 1, cny)))
+
+
+def scatter_state(gm_full: PlanesGeom, fulls, tiles, ox, oy):
+    """Shared scatter-back: write each net's relaxed tile into its full
+    canvases (cells outside the tile keep d0 / SELF-pred / wenter0 —
+    they are unreachable in the uncropped program too) and flatten to
+    the planes_relax return contract."""
+    dxf, dyf, wxf, wyf = fulls
+    dx, dy, predx, predy, wx, wy = tiles
+    B = dxf.shape[0]
+
+    def put(full, tile):
+        return jax.vmap(lambda f, t, x0, y0: lax.dynamic_update_slice(
+            f, t, (0, x0, y0)))(full, tile, ox, oy)
+
+    idxx_f = jnp.broadcast_to(gm_full.idxx, dxf.shape)
+    idxy_f = jnp.broadcast_to(gm_full.idxy, dyf.shape)
+
+    def flat(a, b):
+        return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
+                               axis=1)
+
+    return (flat(put(dxf, dx), put(dyf, dy)),
+            flat(put(idxx_f, predx), put(idxy_f, predy)),
+            flat(put(wxf, wx), put(wyf, wy)))
+
+
 def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
                          wenter0, nsweeps: int, ox, oy,
                          cnx: int, cny: int):
@@ -889,31 +941,10 @@ def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
     unreachable in the full program too: their cc is INF).
 
     Same (dist, pred, wenter) flat returns as planes_relax."""
-    B = d0_flat.shape[0]
-    W, NX, NYp1 = pg.shape_x
-    _, NXp1, NY = pg.shape_y
-    ncx = W * NX * NYp1
-
     gm_full = geom_full(pg)
     gm = geom_cropped(pg, ox, oy, cnx, cny, full=gm_full)
-
-    def crop4(a, xs, ys):
-        return jax.vmap(lambda t, x0, y0: lax.dynamic_slice(
-            t, (0, x0, y0), (W, xs, ys)))(a, ox, oy)
-
-    dxf = d0_flat[:, :ncx].reshape(B, W, NX, NYp1)
-    dyf = d0_flat[:, ncx:].reshape(B, W, NXp1, NY)
-    ccxf = cc_flat[:, :ncx].reshape(B, W, NX, NYp1)
-    ccyf = cc_flat[:, ncx:].reshape(B, W, NXp1, NY)
-    wxf = wenter0[:, :ncx].reshape(B, W, NX, NYp1)
-    wyf = wenter0[:, ncx:].reshape(B, W, NXp1, NY)
-
-    dx = crop4(dxf, cnx, cny + 1)
-    dy = crop4(dyf, cnx + 1, cny)
-    cc_x = crop4(ccxf, cnx, cny + 1)
-    cc_y = crop4(ccyf, cnx + 1, cny)
-    wx = crop4(wxf, cnx, cny + 1)
-    wy = crop4(wyf, cnx + 1, cny)
+    fulls, (dx, dy, cc_x, cc_y, wx, wy) = crop_state(
+        pg, d0_flat, cc_flat, wenter0, ox, oy, cnx, cny)
     predx = jnp.broadcast_to(gm.idxx, dx.shape)
     predy = jnp.broadcast_to(gm.idxy, dy.shape)
 
@@ -922,29 +953,11 @@ def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
     def sweep(_, s):
         return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
-    dx, dy, predx, predy, wx, wy = lax.fori_loop(
-        0, nsweeps, sweep, (dx, dy, predx, predy, wx, wy))
-
+    tiles = lax.fori_loop(0, nsweeps, sweep,
+                          (dx, dy, predx, predy, wx, wy))
     # scatter the tiles back into the full canvases (one full-canvas
     # write per relaxation instead of ~15 traversals per sweep)
-    def put(full, tile):
-        return jax.vmap(lambda f, t, x0, y0: lax.dynamic_update_slice(
-            f, t, (0, x0, y0)))(full, tile, ox, oy)
-
-    idxx_f = jnp.broadcast_to(gm_full.idxx, dxf.shape)
-    idxy_f = jnp.broadcast_to(gm_full.idxy, dyf.shape)
-    dxo = put(dxf, dx)
-    dyo = put(dyf, dy)
-    pxo = put(idxx_f, predx)
-    pyo = put(idxy_f, predy)
-    wxo = put(wxf, wx)
-    wyo = put(wyf, wy)
-
-    def flat(a, b):
-        return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
-                               axis=1)
-
-    return flat(dxo, dyo), flat(pxo, pyo), flat(wxo, wyo)
+    return scatter_state(gm_full, fulls, tiles, ox, oy)
 
 
 # ---------------------------------------------------------------------------
@@ -1112,9 +1125,15 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
             0.0)
 
         if use_pallas:
-            from .planes_pallas import planes_relax_pallas
-            dist, pred, wenter = planes_relax_pallas(
-                pg, d0, cc_flat, crit_c, wenter0, nsweeps)
+            if crop_tile is not None:
+                from .planes_pallas import planes_relax_cropped_pallas
+                dist, pred, wenter = planes_relax_cropped_pallas(
+                    pg, d0, cc_flat, crit_c, wenter0, nsweeps,
+                    crop_ox, crop_oy, cnx_t, cny_t)
+            else:
+                from .planes_pallas import planes_relax_pallas
+                dist, pred, wenter = planes_relax_pallas(
+                    pg, d0, cc_flat, crit_c, wenter0, nsweeps)
         elif crop_tile is not None:
             dist, pred, wenter = planes_relax_cropped(
                 pg, d0, cc_flat, crit_c, wenter0, nsweeps,

@@ -108,8 +108,9 @@ class RouterOpts:
     # bb-cropped planes relaxation (route.h:70-165 per-net boxes as a
     # static crop tile; planes.planes_relax_cropped): "auto" crops a
     # window whenever the bucketed tile is meaningfully smaller than
-    # the grid, "off" always sweeps full canvases.  Work per net then
-    # scales with its bounding box, not the device
+    # the grid, "off" always sweeps full canvases, "WxH" (e.g. "8x8")
+    # forces that tile regardless of the cost model (tuning/tests).
+    # Work per net then scales with its bounding box, not the device
     crop: str = "auto"
 
 
@@ -510,8 +511,16 @@ class Router:
         # number of compiled window-program variants stays O(1) — on
         # the tunneled TPU every new static shape is a remote compile
         crop_cw = crop_ch = 0
-        crop_full = opts.crop != "auto" or self.mesh is not None \
-            or self.use_pallas
+        # crop composes with the Pallas program (tile-blocked VMEM
+        # kernel, planes_relax_cropped_pallas); only the spatially
+        # sharded mesh path keeps full canvases (crops are net-local)
+        crop_forced = None
+        if "x" in opts.crop:
+            cwf, chf = (int(v) for v in opts.crop.split("x"))
+            crop_forced = (min(cwf, rr.grid.nx - 1),
+                           min(chf, rr.grid.ny - 1))
+        crop_full = (opts.crop not in ("auto",) and crop_forced is None) \
+            or self.mesh is not None
 
         if resume is not None:
             # elastic resume: the checkpointed negotiation continues
@@ -579,12 +588,20 @@ class Router:
             # host-widened boxes) run in a SEPARATE full-canvas window
             # call — the planes analogue of the ELL path's narrow/wide
             # group split.  Tiles only grow within one route call (the
-            # compile-variant ratchet); crop is XLA-unsharded-only
-            # (crops are net-local, so the spatial mesh axis and the
-            # per-net Pallas grid keep full canvases)
+            # compile-variant ratchet); the unsharded XLA AND Pallas
+            # programs both crop, only the spatial mesh path keeps
+            # full canvases (crops are net-local)
             crop_tile = None
             narrow = np.ones(len(dirty), dtype=bool)
-            if not crop_full and len(dirty):
+            if crop_forced is not None and len(dirty):
+                Lm = self.pg.max_span
+                crop_tile = crop_forced
+                narrow = ((w_all + 2 * Lm <= crop_forced[0])
+                          & (h_all + 2 * Lm <= crop_forced[1]))
+                if not narrow.any():
+                    crop_tile = None
+                    narrow[:] = True
+            elif not crop_full and len(dirty):
                 Lm = self.pg.max_span
                 NXg, NYg = rr.grid.nx, rr.grid.ny
                 nD = len(dirty)

@@ -91,3 +91,94 @@ def test_pallas_mesh_rejected():
     mesh = make_mesh(min(8, len(jax.devices())))
     with pytest.raises(ValueError):
         Router(f.rr, RouterOpts(program="planes_pallas"), mesh=mesh)
+
+
+@pytest.mark.parametrize("seed", [2, 9])
+def test_cropped_pallas_matches_cropped_xla(seed):
+    """planes_relax_cropped_pallas (interpret) == planes_relax_cropped:
+    identical tile shapes, shared sweep body, identical fold order.
+    Values may differ by an ulp (the interpreter evaluates mult-then-add
+    where XLA's batched fusion emits FMA), so the contract is
+    reachability + fp32-roundoff values + structural equality off ties,
+    like the crop-vs-full gate."""
+    from parallel_eda_tpu.route.planes import planes_relax_cropped
+    from parallel_eda_tpu.route.planes_pallas import (
+        planes_relax_cropped_pallas)
+
+    arch = minimal_arch(chan_width=8)
+    grid = DeviceGrid(12, 10, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    pg = build_planes(rr)
+    N = rr.num_nodes
+    B = 3
+    cnx, cny = 6, 6
+    rng = np.random.default_rng(seed)
+    noc = np.asarray(pg.node_of_cell)
+    W, NX, NYp1 = pg.shape_x
+    _, _, NY = pg.shape_y
+
+    ox = rng.integers(0, NX - cnx, B).astype(np.int32)
+    oy = rng.integers(0, NY - cny, B).astype(np.int32)
+    # finite cc only inside each net's tile (the crop contract); seeds
+    # inside too
+    Lm = pg.max_span
+    inside = np.zeros((B, N), bool)
+    for b in range(B):
+        x0, y0 = int(ox[b]) + Lm, int(oy[b]) + Lm
+        x1 = int(ox[b]) + cnx - Lm
+        y1 = int(oy[b]) + cny - Lm
+        inside[b] = ((rr.xlow >= x0) & (rr.xhigh <= x1)
+                     & (rr.ylow >= y0) & (rr.yhigh <= y1)
+                     & ((rr.node_type == CHANX) | (rr.node_type == CHANY)))
+        assert inside[b].any()
+    cong = rng.uniform(0.5, 2.0, (B, N)).astype(np.float32) * 1e-10
+    cc_n = np.where(inside, cong, np.inf).astype(np.float32)
+    cc = jnp.asarray(cc_n[:, noc])
+    d0n = np.full((B, pg.ncells), np.inf, np.float32)
+    for b in range(B):
+        fin = np.where(np.isfinite(cc_n[b, noc]))[0]
+        d0n[b, rng.choice(fin, 2, replace=False)] = 0.0
+    d0 = jnp.asarray(d0n)
+    crit = jnp.asarray(rng.uniform(0, 0.8, (B, 1, 1, 1))
+                       .astype(np.float32))
+    w0 = jnp.zeros((B, pg.ncells), jnp.float32)
+
+    a = planes_relax_cropped(pg, d0, cc, crit, w0, 24,
+                             jnp.asarray(ox), jnp.asarray(oy), cnx, cny)
+    p = planes_relax_cropped_pallas(pg, d0, cc, crit, w0, 24,
+                                    jnp.asarray(ox), jnp.asarray(oy),
+                                    cnx, cny, interpret=True)
+    da, dp = np.asarray(a[0]), np.asarray(p[0])
+    assert np.array_equal(np.isfinite(da), np.isfinite(dp))
+    fin = np.isfinite(da)
+    np.testing.assert_allclose(dp[fin], da[fin], rtol=1e-5, atol=0)
+    pa, pp = np.asarray(a[1]), np.asarray(p[1])
+    wa, wp = np.asarray(a[2]), np.asarray(p[2])
+    mism = (pa != pp) | (wa != wp)
+    assert mism.mean() < 1e-3, mism.mean()
+    assert np.allclose(da[mism], dp[mism], rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_pallas_cropped_program_full_route():
+    """End-to-end route through the pallas program with a FORCED crop
+    tile (crop="6x6"): exercises the use_pallas+crop_tile dispatch in
+    _step_core (planes_relax_cropped_pallas) including the narrow/wide
+    window split, with legality + determinism + the runtime cropped-step
+    counter as the gates."""
+    from parallel_eda_tpu.flow import run_place_native, synth_flow
+    from parallel_eda_tpu.route import Router, RouterOpts
+    from parallel_eda_tpu.route.check import check_route
+
+    # placed + bb_factor=1 so local nets fit the forced 6x6 tile on
+    # the 8x8 grid (the cost model would not crop a grid this small)
+    f = synth_flow(num_luts=120, chan_width=12, seed=4, bb_factor=1)
+    f = run_place_native(f)
+    opts = RouterOpts(batch_size=16, program="planes_pallas", crop="6x6")
+    r1 = Router(f.rr, opts).route(f.term)
+    assert r1.success
+    check_route(f.rr, f.term, r1.paths, r1.occ)
+    assert r1.total_relax_steps_cropped > 0, "cropped pallas not engaged"
+    r2 = Router(f.rr, RouterOpts(batch_size=16, program="planes_pallas",
+                                 crop="6x6")).route(f.term)
+    assert np.array_equal(np.asarray(r1.paths), np.asarray(r2.paths))
