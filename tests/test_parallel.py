@@ -166,3 +166,25 @@ def test_windowed_sharded_matches_single_device():
     assert np.array_equal(res0.paths, res1.paths)
     assert np.array_equal(res0.occ, res1.occ)
     check_route(rr, term, res1.paths, occ=res1.occ)
+
+
+@pytest.mark.slow
+def test_multislice_mesh_matches_single_device():
+    """make_multislice_mesh (SURVEY §5.8 DCN deployment): 2 virtual
+    slices x 4 chips, node axis intra-slice — the flagship window
+    program must stay bit-identical to single-device under the
+    slice-major layout (the mesh only moves WHERE the deterministic
+    reductions run)."""
+    from parallel_eda_tpu.parallel.shard import make_multislice_mesh
+
+    f = synth_flow(num_luts=20, chan_width=10, seed=5)
+    rr, term = f.rr, f.term
+    mesh = make_multislice_mesh(num_slices=2, chips_per_slice=4,
+                                node_per_slice=2)
+    assert mesh.shape == {"net": 4, "node": 2}
+    r0 = Router(rr, RouterOpts(batch_size=16)).route(term)
+    r1 = Router(rr, RouterOpts(batch_size=16), mesh=mesh).route(term)
+    assert r0.success and r1.success
+    assert np.array_equal(r0.paths, r1.paths)
+    assert np.array_equal(r0.occ, r1.occ)
+    check_route(rr, term, r1.paths, occ=r1.occ)
