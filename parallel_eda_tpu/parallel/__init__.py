@@ -1,1 +1,2 @@
-from .shard import ShardedRouter, make_mesh, shard_graph
+from .shard import (ShardedRouter, make_mesh, make_multislice_mesh,
+                    shard_graph)

@@ -90,6 +90,9 @@ def make_multislice_mesh(num_slices: int, chips_per_slice: int,
     multi-slice topologies; sharded == single-device stays bit-exact
     because the mesh only changes WHERE the same deterministic
     reductions run."""
+    if num_slices < 1 or chips_per_slice < 1 or node_per_slice < 1:
+        raise ValueError("num_slices, chips_per_slice, node_per_slice "
+                         "must all be >= 1")
     if chips_per_slice % node_per_slice:
         raise ValueError(f"chips_per_slice {chips_per_slice} not "
                          f"divisible by node_per_slice {node_per_slice}")
@@ -97,23 +100,21 @@ def make_multislice_mesh(num_slices: int, chips_per_slice: int,
     devs = jax.devices()
     if len(devs) < total:
         raise ValueError(f"need {total} devices, have {len(devs)}")
-    # validate the claimed topology against the devices' REAL slice
+    # validate the guarantee itself against the devices' REAL slice
     # membership where the backend exposes it (multi-slice TPU
     # runtimes set slice_index; virtual CPU meshes don't — there the
-    # layout is a pure convention and nothing can cross a real DCN)
+    # layout is a pure convention and nothing can cross a real DCN):
+    # every NODE-axis row of the grid must live on one slice
     slice_ids = [getattr(d, "slice_index", None) for d in devs[:total]]
     if all(s is not None for s in slice_ids):
-        for i, s in enumerate(slice_ids):
-            owner = slice_ids[(i // chips_per_slice) * chips_per_slice]
-            if s != owner:
+        for r in range(total // node_per_slice):
+            row = slice_ids[r * node_per_slice:(r + 1) * node_per_slice]
+            if len(set(row)) > 1:
                 raise ValueError(
-                    f"device {i} is on slice {s}, but the claimed "
-                    f"(num_slices={num_slices}, chips_per_slice="
-                    f"{chips_per_slice}) layout puts it with slice "
-                    f"{owner}: node-axis groups would cross DCN")
-    # with slice-major membership validated and node_per_slice dividing
-    # chips_per_slice, every node-axis row of the (net, node) grid is
-    # intra-slice; the grid itself is exactly make_mesh's
+                    f"node-axis row {r} spans slices {sorted(set(row))}"
+                    f": the canvas-shard traffic would cross DCN; "
+                    f"check num_slices/chips_per_slice against the "
+                    f"real topology")
     return make_mesh(total, shape=(total // node_per_slice,
                                    node_per_slice))
 

@@ -175,7 +175,7 @@ def test_multislice_mesh_matches_single_device():
     program must stay bit-identical to single-device under the
     slice-major layout (the mesh only moves WHERE the deterministic
     reductions run)."""
-    from parallel_eda_tpu.parallel.shard import make_multislice_mesh
+    from parallel_eda_tpu.parallel import make_multislice_mesh
 
     f = synth_flow(num_luts=20, chan_width=10, seed=5)
     rr, term = f.rr, f.term

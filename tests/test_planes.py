@@ -269,3 +269,32 @@ def test_crop_engaged_route_legal_deterministic():
     assert r2.total_relax_steps_cropped == 0
     # same-quality class (crop changes negotiation order, not validity)
     assert abs(r1.wirelength - r2.wirelength) / r2.wirelength < 0.05
+
+
+@pytest.mark.slow
+def test_crop_timing_driven_crit_path_parity():
+    """Timing-driven (fused device STA) negotiation with the crop
+    engaged: legal, deterministic, and the crit path must match the
+    uncropped program within the QoR bar (measured exact on this
+    fixture)."""
+    from parallel_eda_tpu.flow import run_place_native
+    from parallel_eda_tpu.timing import TimingAnalyzer, build_timing_graph
+
+    f = synth_flow(num_luts=120, chan_width=12, seed=4, bb_factor=1)
+    f = run_place_native(f)
+
+    def run(crop):
+        ta = TimingAnalyzer(build_timing_graph(f.nl, f.pnl, f.term))
+        r = Router(f.rr, RouterOpts(batch_size=16, crop=crop)).route(
+            f.term, analyzer=ta)
+        return r, ta.crit_path_delay
+
+    r1, cpd1 = run("6x6")
+    assert r1.success and r1.total_relax_steps_cropped > 0
+    check_route(f.rr, f.term, r1.paths, r1.occ)
+    r2, cpd2 = run("6x6")
+    assert np.array_equal(np.asarray(r1.paths), np.asarray(r2.paths))
+    assert cpd1 == cpd2
+    r3, cpd3 = run("off")
+    assert r3.success
+    assert cpd1 <= cpd3 * 1.01 + 1e-12          # the <=1% BASELINE bar
