@@ -1,36 +1,35 @@
 #!/bin/bash
-# TPU measurement watchdog (round 4): waits for the tunneled chip to
-# answer (a wedged tunnel HANGS jax.devices(), so every probe runs in a
-# subprocess under `timeout`), then runs the benchmark queue in priority
-# order.  Results land in /tmp/q_<name>.json|log, progress in
-# /tmp/q_status.log.  Run it in the background at round start; see
-# BENCHMARKS.md for what each number decides.
-# Waits for the axon tunnel, then runs the TPU measurement queue.
-# Each probe runs in a subprocess with a hard timeout (a wedged tunnel
-# HANGS rather than fails). Results land in /tmp/q_*.json|log.
-cd /root/repo
+# TPU measurement watchdog: waits for the tunneled chip to answer (a
+# wedged tunnel HANGS jax.devices(), so every probe runs in a
+# subprocess under `timeout`), then runs the benchmark queue in
+# priority order, RE-PROBING before each run so a mid-queue wedge
+# costs one probe, not every remaining run's full timeout.  Results
+# land in /tmp/q_<name>.json|log, progress in /tmp/q_status.log.
+# Run in the background at round start; BENCHMARKS.md explains what
+# each number decides.
+cd /root/repo || exit 1
 probe() {
   timeout 150 python -c "
 import jax, numpy as np, jax.numpy as jnp
 assert jax.devices()[0].platform == 'tpu'
 print(np.asarray(jnp.arange(8).sum()))" >/dev/null 2>&1
 }
-
-echo "$(date -u +%H:%M:%S) waiting for tunnel" >> /tmp/q_status.log
-until probe; do
-  echo "$(date -u +%H:%M:%S) tunnel down" >> /tmp/q_status.log
-  sleep 180
-done
-echo "$(date -u +%H:%M:%S) tunnel UP - starting queue" >> /tmp/q_status.log
-
+wait_up() {
+  until probe; do
+    echo "$(date -u +%H:%M:%S) tunnel down" >> /tmp/q_status.log
+    sleep 180
+  done
+  echo "$(date -u +%H:%M:%S) tunnel UP" >> /tmp/q_status.log
+}
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
+  wait_up
   echo "$(date -u +%H:%M:%S) start $name" >> /tmp/q_status.log
   timeout "$tmo" "$@" >"/tmp/q_$name.json" 2>"/tmp/q_$name.log"
   echo "$(date -u +%H:%M:%S) done $name exit=$?" >> /tmp/q_status.log
 }
-
 run pallas_sweep 2700 python bench.py --sweep_only --program planes_pallas --batch 64
-run scale 5400 python bench.py --scale --serial_timeout 3600
+run crop_sweep 2700 python bench.py --sweep_only --sweep_crop 16 --batch 64
+run scale 5400 python bench.py --scale --serial_timeout 1800
 run pallas_e2e 2700 python bench.py --program planes_pallas
 echo "$(date -u +%H:%M:%S) queue complete" >> /tmp/q_status.log
