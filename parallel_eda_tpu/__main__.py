@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=64,
                    help="nets routed concurrently (replaces --num_threads)")
     p.add_argument("--sink_group", type=int, default=1)
+    p.add_argument("--crop", default="auto",
+                   help="bb-cropped planes relaxation: 'auto' (cost "
+                   "model picks per-net tiles), 'off' (full canvases), "
+                   "or 'WxH' to force a tile (tuning)")
     p.add_argument("--mesh", default="",
                    help="multi-chip route mesh 'NETxNODE' (e.g. 4x2): "
                    "shards nets over NET devices and the rr-graph/"
@@ -138,6 +142,19 @@ def check_options(args) -> None:
                 errs.append("--mesh axes must be >= 1")
     if args.sink_group < 1:
         errs.append("--sink_group must be >= 1")
+    args.crop = args.crop.lower()
+    if args.crop not in ("auto", "off"):
+        try:
+            cw, ch = (int(v) for v in args.crop.split("x"))
+            if cw < 1 or ch < 1:
+                raise ValueError
+        except ValueError:
+            errs.append(f"--crop '{args.crop}' is not auto/off/WxH")
+        else:
+            if args.mesh:
+                errs.append("--crop WxH conflicts with --mesh (crops "
+                            "are net-local; the sharded path keeps "
+                            "full canvases)")
     if args.batch_size < 1:
         errs.append("--batch_size must be >= 1")
     if args.timing_tradeoff < 0 or args.timing_tradeoff > 1:
@@ -248,7 +265,7 @@ def main(argv=None) -> int:
             acc_fac=args.acc_fac, bb_factor=args.bb_factor,
             astar_fac=args.astar_fac,
             batch_size=args.batch_size, sink_group=args.sink_group,
-            stats_dir=args.stats_dir or None)
+            crop=args.crop, stats_dir=args.stats_dir or None)
         import contextlib
         prof = contextlib.nullcontext()
         if args.profile:

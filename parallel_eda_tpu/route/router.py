@@ -515,10 +515,16 @@ class Router:
         # kernel, planes_relax_cropped_pallas); only the spatially
         # sharded mesh path keeps full canvases (crops are net-local)
         crop_forced = None
-        if "x" in opts.crop:
+        if "x" in opts.crop and self.mesh is None:
             cwf, chf = (int(v) for v in opts.crop.split("x"))
             crop_forced = (min(cwf, rr.grid.nx - 1),
                            min(chf, rr.grid.ny - 1))
+        elif "x" in opts.crop:
+            import warnings
+
+            warnings.warn("crop='WxH' is ignored under a mesh (crops "
+                          "are net-local; the spatially sharded path "
+                          "keeps full canvases)")
         crop_full = (opts.crop not in ("auto",) and crop_forced is None) \
             or self.mesh is not None
 
