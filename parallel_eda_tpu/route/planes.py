@@ -1354,6 +1354,12 @@ def route_batch_resident_planes(
     """Standalone one-batch wrapper of _step_core (resident-state
     contract of search.route_batch_resident; the host picked the nets,
     so force=True)."""
+    if crop_tile is not None and bb0_all is None:
+        # the crop anchors on the STATIC initial bb; anchoring on the
+        # live bb would corner-clamp a device-widened net's tile off
+        # its own terminals (silently unroutable)
+        raise ValueError("crop_tile requires bb0_all (static initial "
+                         "bbs) as the crop anchor")
     paths, sink_delay, all_reached, bb, occ, _ = _step_core(
         pg, dev, occ, acc, pres_fac, paths, sink_delay, all_reached, bb,
         source_all, sinks_all, crit_all,

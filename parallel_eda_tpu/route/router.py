@@ -46,6 +46,23 @@ from .search import (build_windows, conflict_subset, iteration_summary,
 _DEBUG_CROP = bool(os.environ.get("PEDA_DEBUG_CROP"))
 
 
+def normalize_crop(value) -> str:
+    """Validate + normalize a crop knob ('auto' | 'off' | 'WxH').
+    Shared by the CLI and Router.route so a typo'd programmatic value
+    raises instead of silently degrading to full-canvas sweeps."""
+    s = str(value).strip().lower()
+    if s in ("auto", "off"):
+        return s
+    parts = s.split("x")
+    try:
+        if len(parts) == 2 and int(parts[0]) > 0 and int(parts[1]) > 0:
+            return s
+    except ValueError:
+        pass
+    raise ValueError(
+        f"crop must be 'auto', 'off', or 'WxH' (got {value!r})")
+
+
 @dataclass
 class RouterOpts:
     """Knobs mirroring s_router_opts (vpr/SRC/base/vpr_types.h:708-770) with
@@ -902,6 +919,9 @@ class Router:
         if resume is not None and self.pg is None:
             raise ValueError("resume is supported by the planes program")
         opts = self.opts
+        # normalized in place (semantics-preserving) so the planes
+        # driver's opts.crop reads see the canonical form
+        opts.crop = normalize_crop(opts.crop)
         rr, dev = self.rr, self.dev
         R, Smax = term.sinks.shape
         N = rr.num_nodes
