@@ -46,6 +46,16 @@ int64_t serial_route(
     int64_t max_iterations, double initial_pres_fac, double pres_fac_mult,
     double acc_fac, double max_pres_fac, double astar_fac,
     double min_wire_cost, double deadline_s,
+    // per-cost-index A* lookahead (route_timing.c:693-760 semantics;
+    // per-node expansions built by route/lookahead.py — operation
+    // order here must match serial_ref.py hcost bit-for-bit)
+    const uint8_t* la_axis,            // [N] 0=CHANX,1=CHANY,2=other
+    const int32_t* la_len_same,        // [N] segment lengths >= 1
+    const int32_t* la_len_ortho,
+    const double* la_tlin_same,        // [N] per-segment delay floors
+    const double* la_tlin_ortho,
+    double la_term_delay,
+    double min_wire_delay,             // flat per-tile delay floor
     // outputs
     int32_t* occ_out,                  // [N]
     int64_t* iters_out, int64_t* pops_out, int64_t* wirelen_out,
@@ -139,6 +149,34 @@ int64_t serial_route(
         int64_t target = sinks[i * Smax + order[k]];
         double cw = crit ? (double)crit[i * Smax + order[k]] : 0.0;
         int64_t tx = xlow[target], ty = ylow[target];
+        // expected remaining cost (route_timing.c:693-760 /
+        // router.cxx:445-640): per-class same/ortho segment counts for
+        // the DELAY term, flat admissible per-tile floor for the
+        // congestion term (see serial_ref.py hcost rationale); matches
+        // serial_ref.py bit-for-bit, and reduces to the round-3
+        // heuristic exactly at crit=0
+        auto hcost = [&](int64_t u) -> double {
+          int64_t man = std::abs((int64_t)xlow[u] - tx)
+                      + std::abs((int64_t)ylow[u] - ty);
+          if (la_axis[u] == 2)
+            return astar_fac * (cw * ((double)man * min_wire_delay)
+                                + (1.0 - cw) * ((double)man
+                                                * min_wire_cost));
+          int64_t dx = std::max<int64_t>(std::max<int64_t>(
+              (int64_t)xlow[u] - tx, tx - (int64_t)xhigh[u]), 0);
+          int64_t dy = std::max<int64_t>(std::max<int64_t>(
+              (int64_t)ylow[u] - ty, ty - (int64_t)yhigh[u]), 0);
+          int64_t dsame = dx, dortho = dy;
+          if (la_axis[u] == 1) { dsame = dy; dortho = dx; }
+          int64_t nsame = (dsame + la_len_same[u] - 1) / la_len_same[u];
+          int64_t northo = (dortho + la_len_ortho[u] - 1)
+                           / la_len_ortho[u];
+          double hd = (double)nsame * la_tlin_same[u]
+                    + (double)northo * la_tlin_ortho[u] + la_term_delay;
+          return astar_fac * (cw * hd
+                              + (1.0 - cw) * ((double)man
+                                              * min_wire_cost));
+        };
         std::fill(dist.begin(), dist.end(),
                   std::numeric_limits<double>::infinity());
         std::fill(prev.begin(), prev.end(), -1);
@@ -146,10 +184,7 @@ int64_t serial_route(
         for (auto& nv : tree) {
           int64_t v = nv.first;
           dist[v] = 0.0;
-          double h = (double)(std::abs((int64_t)xlow[v] - tx)
-                            + std::abs((int64_t)ylow[v] - ty))
-                     * min_wire_cost * astar_fac * (1.0 - cw);
-          heap.push({h, v});
+          heap.push({hcost(v), v});
         }
         bool found = false;
         while (!heap.empty()) {
@@ -167,10 +202,7 @@ int64_t serial_route(
             if (nd < dist[u]) {
               dist[u] = nd;
               prev[u] = (int32_t)v;
-              double h = (double)(std::abs((int64_t)xlow[u] - tx)
-                                + std::abs((int64_t)ylow[u] - ty))
-                         * min_wire_cost * astar_fac * (1.0 - cw);
-              heap.push({nd + h, u});
+              heap.push({nd + hcost(u), u});
             }
           }
         }

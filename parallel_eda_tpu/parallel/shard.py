@@ -137,6 +137,11 @@ def shard_graph(dev: DeviceRRGraph, mesh: Mesh) -> DeviceRRGraph:
         ylow=put(dev.ylow, s_node),
         yhigh=put(dev.yhigh, s_node),
         is_wire=put(dev.is_wire, s_node),
+        la_axis=put(dev.la_axis, s_node),
+        la_len_same=put(dev.la_len_same, s_node),
+        la_len_ortho=put(dev.la_len_ortho, s_node),
+        la_tlin_same=put(dev.la_tlin_same, s_node),
+        la_tlin_ortho=put(dev.la_tlin_ortho, s_node),
     )
 
 

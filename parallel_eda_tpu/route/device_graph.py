@@ -36,6 +36,14 @@ class DeviceRRGraph:
     ylow: jnp.ndarray
     yhigh: jnp.ndarray
     is_wire: jnp.ndarray     # bool [N] CHANX/CHANY (for wirelength stats)
+    # per-node A* lookahead expansions (route/lookahead.py;
+    # route_timing.c:693-760 expected-cost semantics) for the windowed
+    # search's sharpened delay bound
+    la_axis: jnp.ndarray = None       # int8 [N] 0=CHANX,1=CHANY,2=other
+    la_len_same: jnp.ndarray = None   # int32 [N] segment length >= 1
+    la_len_ortho: jnp.ndarray = None  # int32 [N]
+    la_tlin_same: jnp.ndarray = None  # f32 [N] per-segment delay floor
+    la_tlin_ortho: jnp.ndarray = None # f32 [N]
 
     @property
     def num_nodes(self) -> int:
@@ -98,12 +106,23 @@ def wire_cost_floor(rr: RRGraph) -> tuple:
     return min_cong, min_delay, lmax
 
 
-def to_device(rr: RRGraph) -> DeviceRRGraph:
+def to_device(rr: RRGraph, la=None) -> DeviceRRGraph:
+    """``la``: pre-built lookahead.Lookahead tables (built here when
+    absent; Router passes its host copy so the O(N+E) pass runs once)."""
+    from .lookahead import build_lookahead
+
     ell_src, ell_delay, valid = ell_from_csr(
         rr.in_row_ptr, rr.in_src, rr.in_delay)
     norm = delay_normalization(rr)
     is_wire = (rr.node_type == CHANX) | (rr.node_type == CHANY)
+    if la is None:
+        la = build_lookahead(rr)
     return DeviceRRGraph(
+        la_axis=jnp.asarray(la.axis, dtype=jnp.int8),
+        la_len_same=jnp.asarray(la.len_same, dtype=jnp.int32),
+        la_len_ortho=jnp.asarray(la.len_ortho, dtype=jnp.int32),
+        la_tlin_same=jnp.asarray(la.tlin_same, dtype=jnp.float32),
+        la_tlin_ortho=jnp.asarray(la.tlin_ortho, dtype=jnp.float32),
         ell_src=jnp.asarray(ell_src),
         ell_delay=jnp.asarray(ell_delay),
         ell_valid=jnp.asarray(valid),

@@ -361,7 +361,16 @@ class Router:
                  mesh=None):
         self.rr = rr
         self.opts = opts or RouterOpts()
-        self.dev: DeviceRRGraph = to_device(rr)
+        # host-side lookahead tables (route/lookahead.py): shared by
+        # to_device's per-node arrays, the windowed A* gate's delay
+        # bound, and the planes sweep budget (built ONCE — the pass is
+        # O(N+E) and Titan-class graphs are multi-million nodes)
+        from .lookahead import build_lookahead
+        self._la_host = la = build_lookahead(rr)
+        self.dev: DeviceRRGraph = to_device(rr, la=la)
+        self._lmin_seg = tuple(
+            int(la.len_same[la.axis == a].min())
+            if (la.axis == a).any() else 1 for a in (0, 1))
         nx, ny = rr.grid.nx, rr.grid.ny
         # path-length / BF-step bound: a bb-confined path can wind, give slack
         self.max_len = 4 * (nx + ny) + 64
@@ -411,12 +420,16 @@ class Router:
                                 " ".join(str(v) for v in seg) + "\n")
 
     def _lb_scale(self):
-        """Admissible (congestion, delay) cost floors per manhattan tile
-        for the windowed A* gate (shared derivation: wire_cost_floor)."""
+        """[4] scale vector for the windowed A* gate: flat (congestion,
+        delay) per-tile floors x astar_fac, astar_fac itself (applied
+        device-side to the per-cost-index delay bound), and the
+        IPIN+SINK delay tail (lookahead.py; route_timing.c:693-760)."""
         from .device_graph import wire_cost_floor
 
         min_cong, min_delay, _ = wire_cost_floor(self.rr)
-        return (min_cong, min_delay)
+        af = self.opts.astar_fac
+        return (min_cong * af, min_delay * af, af,
+                self._la_host.term_delay)
 
     def _put_batch(self, a: np.ndarray):
         x = jnp.asarray(a)
@@ -678,7 +691,23 @@ class Router:
                 hs = np.where(wide[sub], rr.grid.ny + 2,
                               term.bb_ymax[sub] - term.bb_ymin[sub]
                               + 1) if len(sub) else np.array([8])
-                span = int((ws + hs).max()) if len(sub) else 8
+                # lookahead-informed sweep budget (the planes analogue
+                # of route_timing.c:753 get_expected_segs_to_target):
+                # one min-plus scan pass covers a whole LINE, so the
+                # budget counts line moves — segments, not tiles.  On a
+                # min-length-L arch the bb needs ~span/L direction
+                # changes (+2 end-hop slack); on L=1 archs this reduces
+                # exactly to the tile half-perimeter of earlier rounds.
+                # Under-budget windows self-heal: unreached sinks stay
+                # dirty and sweep_boost doubles.
+                if len(sub):
+                    lx, ly = self._lmin_seg
+                    if lx == 1 and ly == 1:
+                        span = int((ws + hs).max())
+                    else:
+                        span = int((-(-ws // lx) + -(-hs // ly)).max()) + 2
+                else:
+                    span = 8
                 # sweep_boost doubles while overuse stalls: a congested
                 # detour can need more turns than the bb-span heuristic
                 # (the fixed-trip relax has no early exit to lean on)
@@ -1040,8 +1069,8 @@ class Router:
                 win_row = np.full(R, 0, dtype=np.int32)
                 win_row[small_idx] = np.arange(len(small_idx),
                                                dtype=np.int32)
-                lb_scale = jnp.asarray(
-                    self._lb_scale(), dtype=jnp.float32) * opts.astar_fac
+                lb_scale = jnp.asarray(self._lb_scale(),
+                                       dtype=jnp.float32)
 
         pres_fac = opts.initial_pres_fac
         result = RouteResult(False, 0, None, None, None, 0)

@@ -676,9 +676,11 @@ def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
     windowABLE nets (born-wide device-spanning nets are excluded to keep
     the tables small), so each batch carries two index vectors: sel =
     net ids into the resident whole-circuit arrays, sel_win = rows into
-    the compacted window tables.  lb_scale [2] = admissible (congestion,
-    delay) cost lower bound per manhattan tile for the A* gate.  Nets on
-    full-device boxes go through route_batch_resident instead.
+    the compacted window tables.  lb_scale [4] = (min_cong*astar_fac,
+    min_delay*astar_fac, astar_fac, ipin+sink delay tail) for the A*
+    gate — flat per-tile floors in slots 0/1, slot 2 applied device-side
+    to the per-cost-index delay bound, built by Router._lb_scale.  Nets
+    on full-device boxes go through route_batch_resident instead.
 
     Returns (paths, sink_delay, all_reached, occ, relax_steps)."""
     N = dev.num_nodes
@@ -748,6 +750,14 @@ def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
     sink_loc, sink_in = to_local(jnp.clip(b_sinks, 0))
     sink_loc = jnp.where(b_sinks >= 0, sink_loc, Nbox)
 
+    # localized per-node lookahead params (loop-invariant gathers;
+    # route_timing.c:693-760 expected-cost semantics via lookahead.py)
+    la_ax = dev.la_axis[wn_c]                             # [B, Nbox]
+    la_ls = dev.la_len_same[wn_c]
+    la_lo = dev.la_len_ortho[wn_c]
+    la_ts = dev.la_tlin_same[wn_c]
+    la_to = dev.la_tlin_ortho[wn_c]
+
     # --- incremental multi-sink wave loop in window coordinates ---
     seed0 = (jnp.zeros((B, Nbox + 1), bool)
              .at[arangeB[:, None], src_loc].set(True))[:, :Nbox]
@@ -765,10 +775,16 @@ def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
         sx = jnp.take_along_axis(xl, sc, axis=1)
         sy = jnp.take_along_axis(yl, sc, axis=1)
         # per sink-chunk so the [B, Nbox, chunk] transient stays O(B*Nbox)
-        # instead of a multi-GB [B, Nbox, S] blow-up at Titan-class Nbox
+        # instead of a multi-GB [B, Nbox, S] blow-up at Titan-class Nbox.
+        # lb = min over remaining sinks of the node's expected remaining
+        # cost: flat per-tile congestion floor + per-cost-index same/
+        # ortho segment-count DELAY bound (lookahead.py; non-wire nodes
+        # fall back to the flat delay floor).  lb_scale [4] =
+        # (min_cong*af, min_delay*af, af, ipin+sink delay tail)
         S_all = sink_loc.shape[1]
         CH = min(8, S_all)
-        man = jnp.full((B, Nbox), 1 << 28, jnp.int32)
+        cwc = crit_w[:, None, None]
+        lb = jnp.full((B, Nbox), INF, jnp.float32)
         for s0 in range(0, S_all, CH):
             sxc = sx[:, s0:s0 + CH]
             syc = sy[:, s0:s0 + CH]
@@ -779,11 +795,20 @@ def route_batch_resident_win(dev: DeviceRRGraph, win: WindowTables,
             dy = jnp.maximum(jnp.maximum(
                 yl[:, :, None] - syc[:, None, :],
                 syc[:, None, :] - yh[:, :, None]), 0)
-            man = jnp.minimum(man, jnp.min(
-                jnp.where(remc[:, None, :], dx + dy, 1 << 28), axis=2))
-        man = man.astype(jnp.float32)
-        lb = man * ((1.0 - crit_w)[:, None] * lb_scale[0]
-                    + crit_w[:, None] * lb_scale[1])
+            man = (dx + dy).astype(jnp.float32)
+            dsame = jnp.where(la_ax[:, :, None] == 0, dx, dy)
+            dortho = jnp.where(la_ax[:, :, None] == 0, dy, dx)
+            nsame = ((dsame + la_ls[:, :, None] - 1)
+                     // la_ls[:, :, None]).astype(jnp.float32)
+            northo = ((dortho + la_lo[:, :, None] - 1)
+                      // la_lo[:, :, None]).astype(jnp.float32)
+            lbd = (nsame * la_ts[:, :, None] + northo * la_to[:, :, None]
+                   + lb_scale[3]) * lb_scale[2]
+            lbd = jnp.where(la_ax[:, :, None] == 2,
+                            man * lb_scale[1], lbd)
+            cost = (1.0 - cwc) * man * lb_scale[0] + cwc * lbd
+            lb = jnp.minimum(lb, jnp.min(
+                jnp.where(remc[:, None, :], cost, INF), axis=2))
         dist, prev, tdel, steps = _relax_local(
             lsrc, ldelay, cong_c, crit_w[:, None], lb, seed, tdel_tree,
             sink_loc, remaining, max_steps)

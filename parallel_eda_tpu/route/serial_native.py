@@ -91,6 +91,13 @@ class NativeSerialRouter:
             axis=1), np.int32)
         crit_a = (np.ascontiguousarray(crit, np.float32)
                   if crit is not None else None)
+        # per-node A* lookahead expansions (route/lookahead.py; shared
+        # derivation with the Python oracle)
+        la_axis = np.ascontiguousarray(py.la.axis, np.uint8)
+        la_len_same = np.ascontiguousarray(py.la.len_same, np.int32)
+        la_len_ortho = np.ascontiguousarray(py.la.len_ortho, np.int32)
+        la_tlin_same = np.ascontiguousarray(py.la.tlin_same, np.float64)
+        la_tlin_ortho = np.ascontiguousarray(py.la.tlin_ortho, np.float64)
         occ = np.zeros(N, np.int32)
         iters = ctypes.c_int64()
         pops = ctypes.c_int64()
@@ -125,6 +132,13 @@ class NativeSerialRouter:
                 ctypes.c_double(py.astar_fac),
                 ctypes.c_double(py.min_wire_cost),
                 ctypes.c_double(deadline_s or 0.0),
+                p(la_axis, ctypes.c_uint8),
+                p(la_len_same, ctypes.c_int32),
+                p(la_len_ortho, ctypes.c_int32),
+                p(la_tlin_same, ctypes.c_double),
+                p(la_tlin_ortho, ctypes.c_double),
+                ctypes.c_double(py.la.term_delay),
+                ctypes.c_double(py.min_wire_delay),
                 p(occ, ctypes.c_int32),
                 ctypes.byref(iters), ctypes.byref(pops), ctypes.byref(wl),
                 ctypes.byref(rrt), ctypes.byref(timed_out),
