@@ -201,6 +201,14 @@ class Arch:
     # deterministic staggered pattern, and the packer must verify each
     # cluster is intra-routable (pack/cluster_legality.c semantics)
     xbar_density: float = 1.0
+    # multi-mode cluster pb_type tree (pack/pb_type.py PbType;
+    # read_xml_arch_file.c:2528 ProcessPb_Type).  When set, the packer
+    # assigns molecules to leaves with per-slot mode choices and
+    # verifies legality by detail-routing the cluster interconnect
+    # (cluster_legality.c semantics) instead of the flat-crossbar model.
+    # The flat K/N/I fields stay authoritative for the rr-graph's
+    # physical pin counts — keep them consistent with the tree's ports.
+    pb_tree: Optional[object] = None
     # switch-block pattern (<switch_block type= fs=>, ProcessSwitchblocks).
     # The rr builder implements ONE pattern co-designed with the planes
     # kernel's roll stencils: subset continuations/turns + parity-rotated

@@ -272,6 +272,24 @@ def read_arch_xml(path: str) -> Arch:
                     if k_in:
                         K = k_in
                     break
+            # multi-mode cluster: hand the full <pb_type> tree to the
+            # packer (ProcessPb_Type, read_xml_arch_file.c:2528; mode
+            # choice + detail-route legality, cluster_legality.c).
+            # Single-mode clusters keep the flat crossbar model.
+            if next(cluster_pb.iter("mode"), None) is not None:
+                from ..pack.pb_type import parse_pb_type
+                try:
+                    pb_tree_parsed = parse_pb_type(cluster_pb)
+                    from ..pack.pb_pack import validate_pb_tree
+                    validate_pb_tree(pb_tree_parsed)
+                except Exception as e:   # structure/spec not supported
+                    warnings.warn(
+                        f"{path}: multi-mode cluster pb_type not "
+                        f"representable ({type(e).__name__}: {e}); "
+                        f"packing falls back to the flat crossbar "
+                        f"model")
+                else:
+                    arch.pb_tree = pb_tree_parsed
     else:
         warnings.warn(f"{path}: no <complexblocklist>; using k6_N10 defaults")
 

@@ -202,6 +202,34 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
     bles = _form_bles(nl)
     nble = len(bles)
 
+    # legality backend: multi-mode pb tree (assignment + detail route,
+    # cluster_legality.c semantics) when the arch carries one, else the
+    # flat crossbar model
+    pb_tree = getattr(arch, "pb_tree", None)
+    if pb_tree is not None:
+        from .pb_pack import pb_capacity, pb_cluster_feasible
+
+        # nets consumed by pads / hard blocks must surface on cluster
+        # output pins (the want_out leg of the legality route)
+        ext_nets = {p.inputs[0] for p in nl.primitives
+                    if p.kind == PRIM_OUTPAD and p.inputs}
+        for p in nl.primitives:
+            if p.kind == PRIM_HARD:
+                ext_nets.update(n for n in p.inputs if n is not None)
+
+        def feasible(mem):
+            # ``consumers`` binds late: the map is filled just below
+            return pb_cluster_feasible(bles, mem, clocks, arch,
+                                       consumers=consumers,
+                                       ext_nets=ext_nets)
+        cap = pb_capacity(pb_tree)
+        I_eff = sum(p.width for p in pb_tree.ports if p.dir == "input")
+    else:
+        def feasible(mem):
+            return cluster_routable(bles, mem, clocks, arch)
+        cap = N
+        I_eff = I
+
     # net -> producing/consuming BLE indices (over non-clock nets)
     producers: Dict[str, int] = {}
     consumers: Dict[str, List[int]] = {}
@@ -248,7 +276,7 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
         while seed_order[seed_ptr] not in unclustered:
             seed_ptr += 1
         seed = seed_order[seed_ptr]
-        if not cluster_routable(bles, {seed}, clocks, arch):
+        if not feasible({seed}):
             # a lone BLE that cannot route through the cluster crossbar
             # means the netlist does not fit this arch at all — error
             # out like the reference's cluster_legality failure path
@@ -298,16 +326,15 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
             return n
 
         absorb(seed)
-        while len(members) < N:
+        while len(members) < cap:
             best, best_score = None, -1.0
             for c in sorted(cands):
                 bc = bles[c]
                 if bc.clock is not None and clk is not None and bc.clock != clk:
                     continue
-                if inputs_with(c) > I:
+                if inputs_with(c) > I_eff:
                     continue
-                if not cluster_routable(bles, members | {c}, clocks,
-                                        arch):
+                if not feasible(members | {c}):
                     continue
                 s = attraction(members, c)
                 if s > best_score:
@@ -319,9 +346,8 @@ def pack_netlist(nl: LogicalNetlist, arch: Arch,
                     bc = bles[c]
                     if bc.clock is not None and clk is not None and bc.clock != clk:
                         continue
-                    if (inputs_with(c) <= I
-                            and cluster_routable(bles, members | {c},
-                                                 clocks, arch)):
+                    if (inputs_with(c) <= I_eff
+                            and feasible(members | {c})):
                         best = c
                         break
             if best is None:

@@ -52,12 +52,14 @@ class Lookahead:
         h_cong  = manhattan * min_wire_cost        (flat per-tile floor)
         h = astar_fac * (cw*h_delay + (1-cw)*h_cong)
 
-    The congestion term deliberately stays the flat floor: measured on
-    placed 300/1200-LUT fixtures, a per-class congestion term bought no
-    pop reduction (1.03-1.12x) and cost ~4% wirelength, while the
-    per-class delay term alone cuts timing-driven pops 3.5-5x.  At
-    crit=0 the whole h reduces bit-for-bit to the flat heuristic.
-    Non-wire nodes (axis == 2) use the flat floor for both terms.
+    The congestion term deliberately stays the flat floor (min_wire_cost
+    per manhattan tile, derived by device_graph.wire_cost_floor — the
+    consumers hold it themselves): measured on placed 300/1200-LUT
+    fixtures, a per-class congestion term bought no pop reduction
+    (1.03-1.12x) and cost ~4% wirelength, while the per-class delay term
+    alone cuts timing-driven pops 3.5-5x.  At crit=0 the whole h
+    reduces bit-for-bit to the flat heuristic.  Non-wire nodes
+    (axis == 2) use the flat floors for both terms.
     """
     axis: np.ndarray        # uint8 [N]: 0 = CHANX, 1 = CHANY, 2 = other
     len_same: np.ndarray    # int32 [N] >= 1 (segment length, tiles)
@@ -65,8 +67,6 @@ class Lookahead:
     tlin_same: np.ndarray   # f64 [N] per-segment delay floor
     tlin_ortho: np.ndarray  # f64 [N]
     term_delay: float       # IPIN+SINK delay tail
-    min_wire_cost: float    # flat per-tile floor (congestion term +
-                            # non-wire fallback)
 
 
 def build_lookahead(rr: RRGraph) -> Lookahead:
@@ -74,12 +74,9 @@ def build_lookahead(rr: RRGraph) -> Lookahead:
     per-node arrays (load_rr_indexed_data /
     rr_graph_indexed_data.c semantics: T_linear and base cost per cost
     index, ortho_cost_index pairing via the shared segment id)."""
-    from .device_graph import wire_cost_floor
-
     N = rr.num_nodes
     nt = rr.node_type
     wire = (nt == CHANX) | (nt == CHANY)
-    min_wire_cost, _, _ = wire_cost_floor(rr)
 
     ci = rr.cost_index.astype(np.int64)
     nci = int(ci.max()) + 1 if N else 1
@@ -129,5 +126,4 @@ def build_lookahead(rr: RRGraph) -> Lookahead:
     return Lookahead(
         axis=axis, len_same=len_same, len_ortho=len_ortho,
         tlin_same=tlin_same, tlin_ortho=tlin_ortho,
-        term_delay=_tail(nt == IPIN) + _tail(nt == SINK),
-        min_wire_cost=float(min_wire_cost))
+        term_delay=_tail(nt == IPIN) + _tail(nt == SINK))

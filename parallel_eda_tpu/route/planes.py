@@ -1542,7 +1542,16 @@ def route_window_planes(
     NXg = pg.shape_x[1]
     NYg = pg.shape_y[2]
     dev_wide = span >= (NXg + NYg)
+    # measured per-net live bb sizes, packed ((ceil(w/8) << 8) |
+    # ceil(h/8), uint16 — 2 bytes/net through the ~2 MB/s tunnel): the
+    # host re-partitions the next window's narrow/wide split, crop tile
+    # and sweep budget from MEASURED state, the analogue of the
+    # reference's measured-cost re-partition between iterations
+    # (mpi_route_load_balanced_nonblocking_send_recv_encoded.cxx:909-916)
+    wb = jnp.clip(-(-(bb[:, 1] - bb[:, 0] + 1) // 8), 0, 255)
+    hb = jnp.clip(-(-(bb[:, 3] - bb[:, 2] + 1) // 8), 0, 255)
+    live_wh = ((wb << 8) | hb).astype(jnp.uint16)
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
             colors, (over > 0).sum(dtype=jnp.int32),
             over.sum(dtype=jnp.int32), nroutes, nexec, crit_all,
-            dmax_hist, max_span, dev_wide)
+            dmax_hist, max_span, dev_wide, live_wh)

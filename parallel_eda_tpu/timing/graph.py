@@ -87,6 +87,11 @@ class TimingGraph:
     # (-1 = unclocked endpoint, e.g. outpads: constrained by the default)
     endpoint_domain: np.ndarray = None   # int32 [T]
     domains: list = None                 # domain index -> clock net name
+    # SDC I/O constraints (set_input_delay / set_output_delay): pad
+    # port/net name -> tnode (inpads keyed by the net they drive,
+    # outpads by both the pad name and the net they read)
+    inpad_tnode: dict = None
+    outpad_tnode: dict = None
 
 
 def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
@@ -140,10 +145,16 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
     domains = sorted(clocks)
     dom_of = {c: k for k, c in enumerate(domains)}
     endpoint_domain = np.full(T, -1, dtype=np.int32)
+    inpad_tnode: dict = {}
+    outpad_tnode: dict = {}
+    _outpad_dup: set = set()
     for i, p in enumerate(nl.primitives):
         bt = pnl.block_type(block_of_prim[i])
         if p.kind == PRIM_INPAD:
             arrival0[out_tnode[i]] = 0.0
+            inpad_tnode[p.name] = int(out_tnode[i])
+            if p.output is not None:
+                inpad_tnode[p.output] = int(out_tnode[i])
         elif p.kind in (PRIM_FF, PRIM_HARD):
             arrival0[out_tnode[i]] = bt.T_clk_to_q
             is_endpoint[in_tnode[i]] = True
@@ -151,6 +162,16 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
                 endpoint_domain[in_tnode[i]] = dom_of[p.clock]
         elif p.kind == PRIM_OUTPAD:
             is_endpoint[in_tnode[i]] = True
+            outpad_tnode[p.name] = int(in_tnode[i])
+            if p.inputs and p.inputs[0] is not None:
+                # net-name key only while unambiguous: two pads reading
+                # the same net must not alias (the pad NAME always works)
+                n = p.inputs[0]
+                if n in outpad_tnode and outpad_tnode[n] != int(
+                        in_tnode[i]):
+                    _outpad_dup.add(n)
+                else:
+                    outpad_tnode[n] = int(in_tnode[i])
 
     # ---- edges ----
     e_src, e_dst, e_const, e_ridx = [], [], [], []
@@ -227,4 +248,7 @@ def build_timing_graph(nl: LogicalNetlist, pnl: PackedNetlist,
         num_route_slots=R * Smax,
         tnode_prim=np.array(tnode_prim, dtype=np.int32),
         endpoint_domain=endpoint_domain, domains=domains,
+        inpad_tnode=inpad_tnode,
+        outpad_tnode={k: v for k, v in outpad_tnode.items()
+                      if k not in _outpad_dup},
     )

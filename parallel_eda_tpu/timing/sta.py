@@ -169,13 +169,51 @@ class TimingAnalyzer:
         self.sdc = sdc
         self._req_seed = None
         if sdc is not None:
+            # a typo'd -clock reference must error, not silently fall
+            # back to the default period (same contract as port names)
+            declared = set(sdc.clock_periods) | set(sdc.virtual_clocks)
+            for port, (clk, _d) in list(sdc.input_delays.items()) + \
+                    list(sdc.output_delays.items()):
+                if clk is not None and clk not in declared:
+                    raise ValueError(
+                        f"I/O delay on {port!r} references undeclared "
+                        f"clock {clk!r}")
             req = np.full(tg.num_tnodes, np.inf, dtype=np.float32)
             default = sdc.default_period or np.inf
             for t in np.where(tg.is_endpoint)[0]:
                 d = int(tg.endpoint_domain[t])
-                p = (sdc.period_of(tg.domains[d]) if d >= 0 else default)
-                req[t] = p if p is not None else np.inf
+                cname = tg.domains[d] if d >= 0 else None
+                p = sdc.period_of(cname) if d >= 0 else default
+                p = p if p is not None else np.inf
+                # set_multicycle_path -setup: the matching constraint
+                # relaxes to N periods (read_sdc.c:50 application)
+                if np.isfinite(p):
+                    p = p * sdc.multicycle_for(cname)
+                req[t] = p
+            # set_output_delay (read_sdc.c:46): the external path eats
+            # into the period — required time = N*period - delay
+            for port, (clk, dly) in sdc.output_delays.items():
+                t = (tg.outpad_tnode or {}).get(port)
+                if t is None:
+                    raise ValueError(
+                        f"set_output_delay: unknown output port {port!r}")
+                p = sdc.period_of(clk)
+                p = p if p is not None else np.inf
+                if np.isfinite(p):
+                    req[t] = p * sdc.multicycle_for(clk) - dly
             self._req_seed = jnp.asarray(req)
+            # set_input_delay (read_sdc.c:44): the input pad launches
+            # after the external delay — arrival seed = delay
+            if sdc.input_delays:
+                arr0 = np.array(tg.arrival0, copy=True)
+                for port, (clk, dly) in sdc.input_delays.items():
+                    t = (tg.inpad_tnode or {}).get(port)
+                    if t is None:
+                        raise ValueError(
+                            f"set_input_delay: unknown input port "
+                            f"{port!r}")
+                    arr0[t] = dly
+                self.dev = self.dev.replace(arrival0=jnp.asarray(arr0))
 
     def analyze(self, sink_delay: np.ndarray) -> np.ndarray:
         """sink_delay [R, Smax] from the router -> criticalities [R, Smax];

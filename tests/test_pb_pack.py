@@ -1,0 +1,162 @@
+"""Multi-mode pb_type packing: parse, mode assignment, route-based
+legality (read_xml_arch_file.c:2528 ProcessPb_Type + ProcessMode;
+cluster_legality.c detail routing), and a mode-bearing arch flowing
+end-to-end through pack -> place -> route."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from parallel_eda_tpu.arch.builtin import _FRAC_PB_XML, frac_arch
+from parallel_eda_tpu.flow import prepare, run_place, run_route
+from parallel_eda_tpu.netlist.generate import generate_circuit
+from parallel_eda_tpu.netlist.netlist import (LogicalNetlist, Primitive,
+                                              PRIM_FF, PRIM_INPAD,
+                                              PRIM_LUT, PRIM_OUTPAD)
+from parallel_eda_tpu.pack.packer import _form_bles, pack_netlist
+from parallel_eda_tpu.pack.pb_pack import (assign_molecules, pb_capacity,
+                                           pb_cluster_feasible)
+from parallel_eda_tpu.pack.pb_type import (build_pb_graph, parse_pb_type,
+                                           route_cluster)
+
+
+def _tree(n=4, i=20):
+    return parse_pb_type(ET.fromstring(
+        _FRAC_PB_XML.format(I=i, O=2 * n, N=n, NM1=n - 1)))
+
+
+def test_parse_modes_and_route():
+    pb = _tree()
+    assert [m.name for m in pb.modes[0].children[0].modes] == \
+        ["lut6", "lut5x2"]
+    # slot 0 as one 6-LUT, slot 1 fractured into two 5-LUTs
+    g = build_pb_graph(pb, {"clb/ble[0]": 0, "clb/ble[1]": 1})
+    assert "clb/ble[0]/lut6[0]" in g.leaves
+    assert "clb/ble[1]/lut5[1]" in g.leaves
+    pin = g.pin
+    sigs = [
+        {"source": None, "sinks": [pin("clb/ble[0]/lut6[0]", "in", 0)]},
+        {"source": pin("clb/ble[0]/lut6[0]", "out", 0),
+         "sinks": [pin("clb/ble[0]/ff[0]", "D", 0)]},
+        {"source": pin("clb/ble[0]/ff[0]", "Q", 0),
+         "sinks": [pin("clb/ble[1]/lut5[0]", "in", 0)],
+         "want_out": True},
+    ]
+    assert route_cluster(g, sigs) is not None
+    # 21 distinct external signals cannot enter a 20-input cluster
+    over = [{"source": None,
+             "sinks": [pin("clb/ble[0]/lut6[0]", "in", k % 6)]}
+            for k in range(21)]
+    assert route_cluster(g, over) is None
+
+
+def _mixed_netlist():
+    """One 6-input LUT and two 3-input LUT+FF molecules."""
+    nl = LogicalNetlist(name="mix")
+    nl.add(Primitive(name="clk", kind=PRIM_INPAD, output="clk"))
+    for k in range(6):
+        nl.add(Primitive(name=f"i{k}", kind=PRIM_INPAD, output=f"i{k}"))
+    nl.add(Primitive(name="big", kind=PRIM_LUT,
+                     inputs=[f"i{k}" for k in range(6)], output="big",
+                     truth_table=["111111 1"]))
+    for t in ("a", "b"):
+        nl.add(Primitive(name=f"l{t}", kind=PRIM_LUT,
+                         inputs=["i0", "i1", "big"], output=f"l{t}",
+                         truth_table=["111 1"]))
+        nl.add(Primitive(name=f"r{t}", kind=PRIM_FF, inputs=[f"l{t}"],
+                         output=f"r{t}", clock="clk"))
+    for t in ("a", "b"):
+        nl.add(Primitive(name=f"o{t}", kind=PRIM_OUTPAD,
+                         inputs=[f"r{t}"]))
+    nl.add(Primitive(name="obig", kind=PRIM_OUTPAD, inputs=["big"]))
+    nl.finalize()
+    return nl
+
+
+def test_assignment_picks_modes():
+    nl = _mixed_netlist()
+    tree = _tree()
+    bles = _form_bles(nl)
+    clocks = set(nl.clocks)
+    got = assign_molecules(bles, set(range(len(bles))), clocks, tree)
+    assert got is not None
+    mode_sel, assign = got
+    # the 6-input LUT must sit in a lut6-mode slot; the two 3-input
+    # molecules share one fractured slot
+    assert 0 in mode_sel.values() and 1 in mode_sel.values()
+    fractured = [s for s, mi in mode_sel.items() if mi == 1]
+    assert len(fractured) == 1
+    arch_like = type("A", (), {"pb_tree": tree})
+    assert pb_cluster_feasible(bles, set(range(len(bles))), clocks,
+                               arch_like)
+    # output capacity: the frac tree has 8 cluster outputs; all three
+    # molecule outputs needed outside still fit
+    assert pb_cluster_feasible(
+        bles, set(range(len(bles))), clocks, arch_like,
+        consumers={}, ext_nets={b.output for b in bles})
+    assert pb_capacity(tree) == 8          # 4 slots x 2 lut5 leaves
+
+
+def test_oversized_lut_rejected():
+    tree = _tree()
+    nl = LogicalNetlist(name="big7")
+    for k in range(7):
+        nl.add(Primitive(name=f"i{k}", kind=PRIM_INPAD, output=f"i{k}"))
+    nl.add(Primitive(name="w", kind=PRIM_LUT,
+                     inputs=[f"i{k}" for k in range(7)], output="w",
+                     truth_table=["1111111 1"]))
+    nl.add(Primitive(name="ow", kind=PRIM_OUTPAD, inputs=["w"]))
+    nl.finalize()
+    bles = _form_bles(nl)
+    assert assign_molecules(bles, {0}, set(), tree) is None
+
+
+def test_frac_arch_end_to_end():
+    arch = frac_arch(N=4, I=20, chan_width=14)
+    nl = generate_circuit(num_luts=30, num_inputs=8, num_outputs=6,
+                          K=6, seed=5, ff_ratio=0.4)
+    flow = prepare(nl, arch, 14)
+    # every cluster the packer produced must re-verify as pb-routable
+    bles = _form_bles(nl)
+    clocks = set(nl.clocks)
+    prim_to_ble = {}
+    for bi, b in enumerate(bles):
+        if b.lut is not None:
+            prim_to_ble[b.lut] = bi
+        if b.ff is not None:
+            prim_to_ble[b.ff] = bi
+    n_frac_checked = 0
+    for blk in flow.pnl.blocks:
+        if blk.type_name != "clb":
+            continue
+        members = {prim_to_ble[p] for p in blk.prims}
+        assert pb_cluster_feasible(bles, members, clocks, arch)
+        n_frac_checked += 1
+    assert n_frac_checked >= 2
+    flow = run_place(flow)
+    flow = run_route(flow)
+    assert flow.route.success
+
+
+def test_xml_cluster_with_modes_builds_tree(tmp_path):
+    from parallel_eda_tpu.arch.xml_parser import read_arch_xml
+
+    frac = _FRAC_PB_XML.format(I=20, O=8, N=4, NM1=3)
+    xml = f"""<architecture>
+      <complexblocklist>
+        <pb_type name="io" capacity="4"/>
+        {frac}
+      </complexblocklist>
+      <device><fc default_in_type="frac" default_in_val="0.5"
+                  default_out_type="frac" default_out_val="0.4"/></device>
+      <segmentlist>
+        <segment name="l1" length="1" freq="1" type="bidir">
+        </segment>
+      </segmentlist>
+    </architecture>"""
+    p = tmp_path / "frac.xml"
+    p.write_text(xml)
+    arch = read_arch_xml(str(p))
+    assert arch.pb_tree is not None
+    assert arch.pb_tree.modes[0].children[0].name == "ble"
+    assert arch.K == 6 and arch.I == 20 and arch.N == 8

@@ -105,3 +105,71 @@ def test_sdc_violated_slack_reported():
     flow = run_route(flow)
     assert flow.route.success
     assert flow.analyzer.worst_slack < 0
+
+
+def test_parse_sdc_io_and_multicycle():
+    sdc = parse_sdc("""
+    create_clock -period 4.0 clk
+    set_input_delay -clock clk -max 1.25 [get_ports {a b}]
+    set_input_delay -clock clk -min 0.25 [get_ports {a b}]
+    set_output_delay -clock clk -max 0.5 out1
+    set_output_delay -clock clk -min -0.1 out1
+    set_multicycle_path -setup -from clk -to clk 3
+    set_multicycle_path -hold -to clk 4
+    """)
+    approx = lambda a, b: abs(a - b) < 1e-15
+    assert sdc.input_delays["a"][0] == "clk"
+    assert approx(sdc.input_delays["a"][1], 1.25e-9)
+    assert approx(sdc.input_delays["b"][1], 1.25e-9)
+    assert sdc.output_delays["out1"][0] == "clk"
+    assert approx(sdc.output_delays["out1"][1], 0.5e-9)
+    # hold constraints are accepted and ignored (setup-only analysis)
+    assert sdc.multicycles == [("clk", "clk", 3)]
+    assert sdc.multicycle_for("clk") == 3
+    assert sdc.multicycle_for("other") == 1
+
+
+def test_sdc_multicycle_and_io_delays_in_sta():
+    from parallel_eda_tpu.timing import TimingAnalyzer
+
+    nl = _two_clock_netlist()
+    flow = prepare(nl, minimal_arch(), chan_width=10)
+    base_sdc = ("create_clock -period 100.0 clk_a\n"
+                "create_clock -period 2.0 clk_b\n")
+    flow.sdc = parse_sdc(base_sdc)
+    flow = run_route(flow)
+    assert flow.route.success
+    sd, tg = flow.route.sink_delay, flow.tg
+    base = TimingAnalyzer(tg, sdc=flow.sdc)
+    base.analyze(sd)
+
+    # multicycle -to clk_b relaxes that domain's budget to 2 periods;
+    # the device STA must match the host oracle run at 2x the period
+    a_mc = TimingAnalyzer(tg, sdc=parse_sdc(
+        base_sdc + "set_multicycle_path -setup -to clk_b 2\n"))
+    a_mc.analyze(sd)
+    assert a_mc.worst_slack > base.worst_slack
+    dmax, worst = _host_sta_oracle(
+        tg, sd, {"clk_a": 100e-9, "clk_b": 4e-9}, 100e-9)
+    assert abs(a_mc.worst_slack - worst) < 1e-12 + 1e-4 * abs(worst)
+    assert abs(a_mc.crit_path_delay - dmax) < 1e-12 + 1e-4 * abs(dmax)
+
+    # a huge external input delay on in_b dominates every internal path:
+    # arrival at rb0's setup endpoint grows by ~50ns
+    a_in = TimingAnalyzer(tg, sdc=parse_sdc(
+        base_sdc + "set_input_delay -clock clk_b 50.0 in_b\n"))
+    a_in.analyze(sd)
+    assert a_in.crit_path_delay > base.crit_path_delay + 40e-9
+    assert a_in.worst_slack < base.worst_slack - 40e-9
+
+    # an output delay eats the outpad's budget: required time drops
+    # from the default 100ns period to 2ns - 1ns
+    a_out = TimingAnalyzer(tg, sdc=parse_sdc(
+        base_sdc + "set_output_delay -clock clk_b 1.0 out:b\n"))
+    a_out.analyze(sd)
+    assert a_out.worst_slack < base.worst_slack
+    # unknown port names must raise, not silently constrain nothing
+    import pytest
+    with pytest.raises(ValueError):
+        TimingAnalyzer(tg, sdc=parse_sdc(
+            base_sdc + "set_output_delay -clock clk_b 1.0 nosuch\n"))

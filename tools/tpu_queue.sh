@@ -45,4 +45,8 @@ run default 2700 python bench.py
 run scale 7200 python bench.py --scale --serial_timeout 1800
 run place 3600 python bench.py --place_only --luts 1200 --chan_width 20
 run pallas_e2e 2700 python bench.py --program planes_pallas
+# ladder step 3 (BASELINE.md): 10k LUTs, 267k rr nodes, W=20 — placed
+# natively on host, routed on chip (crop+pallas auto), serial capped.
+# Last: new shapes mean long remote compiles; must not starve the rest
+run scale10k 10800 python bench.py --scale --luts 10000 --chan_width 20 --serial_timeout 1800
 echo "$(date -u +%H:%M:%S) queue complete" >> /tmp/q_status.log
