@@ -62,11 +62,19 @@ def build_parser() -> argparse.ArgumentParser:
                    "riskier; no effect on full-device searches)")
     p.add_argument("--batch_size", type=int, default=64,
                    help="nets routed concurrently (replaces --num_threads)")
-    p.add_argument("--sink_group", type=int, default=1)
+    p.add_argument("--sink_group", type=int, default=1,
+                   help="sinks per wave: 1 = exact VPR incremental "
+                   "trees, 0 = all-sink doubling schedule (the batch "
+                   "fast path; pairs with the wirelength finishing "
+                   "pass), >1 = grouped middle ground")
     p.add_argument("--crop", default="auto",
                    help="bb-cropped planes relaxation: 'auto' (cost "
                    "model picks per-net tiles), 'off' (full canvases), "
                    "or 'WxH' to force a tile (tuning)")
+    p.add_argument("--no_finish", action="store_true",
+                   help="skip the wirelength finishing pass (one "
+                   "precise multi-sink reroute at convergence; only "
+                   "active with --sink_group 0)")
     p.add_argument("--mesh", default="",
                    help="multi-chip route mesh 'NETxNODE' (e.g. 4x2): "
                    "shards nets over NET devices and the rr-graph/"
@@ -140,8 +148,8 @@ def check_options(args) -> None:
         else:
             if net_ax < 1 or node_ax < 1:
                 errs.append("--mesh axes must be >= 1")
-    if args.sink_group < 1:
-        errs.append("--sink_group must be >= 1")
+    if args.sink_group < 0:
+        errs.append("--sink_group must be >= 0")
     args.crop = args.crop.lower()
     if args.crop not in ("auto", "off"):
         try:
@@ -265,7 +273,8 @@ def main(argv=None) -> int:
             acc_fac=args.acc_fac, bb_factor=args.bb_factor,
             astar_fac=args.astar_fac,
             batch_size=args.batch_size, sink_group=args.sink_group,
-            crop=args.crop, stats_dir=args.stats_dir or None)
+            crop=args.crop, finish_precise=not args.no_finish,
+            stats_dir=args.stats_dir or None)
         import contextlib
         prof = contextlib.nullcontext()
         if args.profile:

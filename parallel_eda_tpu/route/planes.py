@@ -976,7 +976,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                sel, valid, force, full_bb,
                nsweeps: int, max_len: int, num_waves: int, group: int,
                doubling: bool, mesh, use_pallas: bool = False,
-               crop_tile=None, bb0_all=None):
+               crop_tile=None, bb0_all=None, widen_ok=None):
     """One fused batch step (traceable body shared by the standalone
     per-batch wrapper and the window program): rip up the selected nets,
     re-route each against the occupancy view of everyone-but-itself with
@@ -1324,7 +1324,18 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
 
     smask = b_sinks >= 0
     ok = (reached | ~smask).all(axis=1)
-    new_bb = jnp.where(ok[:, None], b_bb, full_bb[None, :])
+    # unreached-sink widening retry — gated per net by widen_ok: a net
+    # routed under a REDUCED sweep budget (RouterOpts.sweep_budget_div)
+    # must not take a full-device bb for what may only be an
+    # under-budgeted relaxation; the host promotes it to the full
+    # budget first (the unreached summary output) and only a
+    # full-budget failure widens
+    if widen_ok is None:
+        may_widen = jnp.ones((B,), bool)
+    else:
+        may_widen = widen_ok[sel]
+    new_bb = jnp.where((ok | ~may_widen)[:, None], b_bb,
+                       full_bb[None, :])
 
     sel_v = jnp.where(valid, sel, R).astype(jnp.int32)
     paths = paths.at[sel_v].set(p, mode="drop")
@@ -1436,7 +1447,7 @@ def route_window_planes(
         tdev=None, req_seed=None, sta_depth: int = 0,
         crit_exp: float = 1.0, max_crit: float = 0.99,
         use_sdc: bool = False, use_pallas: bool = False,
-        crop_tile=None, bb0_all=None):
+        crop_tile=None, bb0_all=None, widen_ok=None):
     """A WINDOW of K_iters complete PathFinder iterations as ONE device
     program: per iteration, every batch group in sel_plan [G, B] runs the
     fused rip-up/route/commit step (clean nets no-op via the device-side
@@ -1484,7 +1495,7 @@ def route_window_planes(
                     direct_oidx_all, direct_ipin_all, direct_delay_all,
                     sel_plan[g], valid_plan[g], force, full_bb,
                     nsweeps, max_len, num_waves, group, doubling, mesh,
-                    use_pallas, crop_tile, bb0_all)
+                    use_pallas, crop_tile, bb0_all, widen_ok)
                 return (occ2, paths2, sink_delay2, all_reached2, bb2,
                         nr + n_act, ng + 1)
 
@@ -1551,7 +1562,11 @@ def route_window_planes(
     wb = jnp.clip(-(-(bb[:, 1] - bb[:, 0] + 1) // 8), 0, 255)
     hb = jnp.clip(-(-(bb[:, 3] - bb[:, 2] + 1) // 8), 0, 255)
     live_wh = ((wb << 8) | hb).astype(jnp.uint16)
+    # per-net unreached flag: the host's sweep-budget promotion signal
+    # (reduced-budget nets that missed a sink retry at full budget
+    # before any widening)
+    unreached = ~all_reached
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
             colors, (over > 0).sum(dtype=jnp.int32),
             over.sum(dtype=jnp.int32), nroutes, nexec, crit_all,
-            dmax_hist, max_span, dev_wide, live_wh)
+            dmax_hist, max_span, dev_wide, live_wh, unreached)

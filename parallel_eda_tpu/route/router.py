@@ -129,6 +129,17 @@ class RouterOpts:
     # forces that tile regardless of the cost model (tuning/tests).
     # Work per net then scales with its bounding box, not the device
     crop: str = "auto"
+    # EXPERIMENTAL reduced first-try sweep budget (planes program):
+    # 1 = off (budget = bb line-move span, the always-sufficient bound);
+    # d > 1 dispatches each net's first relaxation with span/d sweeps —
+    # most paths need only a few direction changes, so the common case
+    # does ~d times less sweep work.  A net that misses a sink under a
+    # reduced budget is PROMOTED to the full budget for the next window
+    # instead of taking the unreached->full-device bb widening (the
+    # widen_ok gate in planes._step_core); only a full-budget miss
+    # widens.  Work-efficiency lever for the at-scale configs
+    # (BENCHMARKS.md round-5); measured before any default flip.
+    sweep_budget_div: int = 1
     # wirelength finishing pass (planes program, sink_group=0 only):
     # at first convergence, rip up and re-route EVERYTHING once with
     # the exact incremental sink schedule against the converged
@@ -620,6 +631,14 @@ class Router:
         else:
             live_w = (term.bb_xmax - term.bb_xmin + 1).astype(np.int64)
             live_h = (term.bb_ymax - term.bb_ymin + 1).astype(np.int64)
+        # reduced-budget promotion state (sweep_budget_div > 1): nets
+        # that missed a sink under a reduced budget run at full budget
+        # from then on
+        if resume is not None:
+            budget_full = resume.driver.get(
+                "budget_full", np.zeros(R, dtype=bool)).copy()
+        else:
+            budget_full = np.zeros(R, dtype=bool)
         while it_done < opts.max_router_iterations:
             K = self._WINDOWS[min(widx, len(self._WINDOWS) - 1)]
             if (timing_cb is not None and analyzer is None) \
@@ -702,6 +721,9 @@ class Router:
                       int(narrow.sum()), "/", len(dirty),
                       "crop_full", crop_full, flush=True)
 
+            widen_d = (None if opts.sweep_budget_div <= 1
+                       else jnp.asarray(budget_full))
+
             def window_call(sub, tile, esc, pres_in):
                 """One route_window_planes dispatch over the `sub`
                 subset of dirty nets.  esc=False freezes the acc
@@ -725,18 +747,37 @@ class Router:
                 # exactly to the tile half-perimeter of earlier rounds.
                 # Under-budget windows self-heal: unreached sinks stay
                 # dirty and sweep_boost doubles.
+                wok = widen_d
                 if len(sub):
                     lx, ly = self._lmin_seg
                     if lx == 1 and ly == 1:
-                        span = int((ws + hs).max())
+                        spans_full = ws + hs
                     else:
-                        span = int((-(-ws // lx) + -(-hs // ly)).max()) + 2
+                        spans_full = -(-ws // lx) + -(-hs // ly) + 2
+                    spans = spans_full
+                    if opts.sweep_budget_div > 1:
+                        # reduced first-try budget; promoted/wide nets
+                        # keep the full line-move bound
+                        red = np.maximum(8, spans_full
+                                         // opts.sweep_budget_div)
+                        spans = np.where(budget_full[sub] | wide[sub],
+                                         spans_full, red)
+                    span = int(spans.max())
                 else:
                     span = 8
                 # sweep_boost doubles while overuse stalls: a congested
                 # detour can need more turns than the bb-span heuristic
                 # (the fixed-trip relax has no early exit to lean on)
                 nsw = min(128, -(-max(8, span * sweep_boost) // 8) * 8)
+                if wok is not None and len(sub):
+                    # a net whose DISPATCHED budget covers its full
+                    # line-move bound may widen on a miss regardless of
+                    # its promotion state (mixed subsets lift everyone
+                    # to the max net's budget — denying those widening
+                    # would burn a pointless promotion round trip)
+                    wok_np = budget_full.copy()
+                    wok_np[sub[spans_full <= nsw]] = True
+                    wok = jnp.asarray(wok_np)
                 maxfan = int(nsinks_np[sub].max()) if len(sub) else 1
                 doubling = opts.sink_group == 0 and not precise
                 grp_w = 1 if precise and opts.sink_group == 0 else grp
@@ -758,7 +799,7 @@ class Router:
                     K, nsw, L, waves, grp_w,
                     doubling, min(4096, N), 5, self.mesh,
                     use_pallas=self.use_pallas, crop_tile=tile,
-                    bb0_all=bb0_d, **sta_kw)
+                    bb0_all=bb0_d, widen_ok=wok, **sta_kw)
                 return out, waves * nsw
 
             t0 = time.time()
@@ -792,14 +833,20 @@ class Router:
             # per-iteration crit-path delays from the fused STA;
             # max_span: largest dirty-net bb for path-budget regrowth)
             (rrm, colors, n_over, over_total, nroutes, nexec, dmax_hist,
-             max_span, dev_wide, live_wh) = (
+             max_span, dev_wide, live_wh, unreached) = (
                 np.asarray(v) for v in jax.device_get(
                     (out[7], out[8], out[9], out[10], out[11],
-                     out[12], out[14], out[15], out[16], out[17])))
+                     out[12], out[14], out[15], out[16], out[17],
+                     out[18])))
             # unpack measured live bb sizes (8-tile buckets, see
             # planes.py summary); feeds the next window's partition
             live_w = ((live_wh.astype(np.int64) >> 8) & 0xFF) * 8
             live_h = (live_wh.astype(np.int64) & 0xFF) * 8
+            if opts.sweep_budget_div > 1:
+                # reduced-budget promotion: a miss retries at full
+                # budget (feature-off runs must not accumulate state —
+                # a later resume with div>1 would be pre-promoted)
+                budget_full |= unreached
             crit_d = out[13]            # donated in; stays device-resident
             # fold device-side widening into the host classification:
             # those nets must take the full-canvas window from now on
@@ -963,6 +1010,7 @@ class Router:
                         full_reroute_done=full_reroute_done,
                         force_all_next=force_all_next,
                         finish_done=finish_done,
+                        budget_full=budget_full.copy(),
                         widened_nets=result.widened_nets,
                         crop_cw=crop_cw, crop_ch=crop_ch,
                         crop_full=crop_full))

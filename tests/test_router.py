@@ -176,3 +176,23 @@ def test_route_dump_routes(tmp_path):
     assert len(dumps) == res.iterations
     body = open(os.path.join(sd, "routes_iter_1.txt")).read()
     assert ":" in body
+
+
+def test_sweep_budget_div_parity():
+    """Reduced first-try sweep budgets (RouterOpts.sweep_budget_div)
+    must converge to a legal route of the same quality class: misses
+    promote to the full budget (widen_ok gate, planes._step_core)
+    instead of spuriously widening to full-device bbs."""
+    import numpy as np
+
+    from parallel_eda_tpu.flow import synth_flow
+    from parallel_eda_tpu.route import Router, RouterOpts
+    from parallel_eda_tpu.route.check import check_route
+
+    f = synth_flow(num_luts=60, chan_width=12, seed=11)
+    r1 = Router(f.rr, RouterOpts(batch_size=32)).route(f.term)
+    r2 = Router(f.rr, RouterOpts(batch_size=32,
+                                 sweep_budget_div=3)).route(f.term)
+    assert r1.success and r2.success
+    check_route(f.rr, f.term, r2.paths, np.asarray(r2.occ))
+    assert r2.wirelength <= r1.wirelength * 1.05
