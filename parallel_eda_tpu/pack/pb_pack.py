@@ -231,6 +231,10 @@ def validate_pb_tree(tree: PbType) -> None:
     not a crash mid-pack).  Builds the pin graph once per slot-mode
     index, which expands every interconnect expression."""
     slots = _slots(tree)
+    # every slot mode's leaf structure (raises on unsupported nesting,
+    # e.g. VTR's fle -> ble6 indirection) ...
+    pb_capacity(tree)
+    # ... and every mode's interconnect expansion
     n_modes = max(len(pbt.modes) for pbt, _ in slots) if slots else 0
     for mi in range(n_modes):
         sel = {path: min(mi, len(pbt.modes) - 1)
