@@ -94,7 +94,8 @@ def _recorded_path(args) -> str:
                f"_g{args.sweep_max_grid}")
     else:
         key = (f"scale{int(bool(args.scale))}_l{args.luts}"
-               f"_w{args.chan_width}_{args.program}_b{args.batch}")
+               f"_w{args.chan_width}_{args.program}_b{args.batch}"
+               f"_d{args.budget_div}")
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "bench_tpu", f"{key}.json")
 
@@ -395,6 +396,10 @@ def main():
     ap.add_argument("--moves_per_step", type=int, default=256,
                     help="with --place_only: batched proposals per "
                          "device SA step (M)")
+    ap.add_argument("--budget_div", type=int, default=1,
+                    help="RouterOpts.sweep_budget_div: reduced "
+                         "first-try sweep budgets (1 = off; the "
+                         "at-scale work-efficiency experiment)")
     args = ap.parse_args()
     serial_error = None
     if args.scale and args.luts == 60:
@@ -449,7 +454,8 @@ def main():
     # program variant the negotiation loop can hit; the SAME Router is
     # reused so the device-resident terminal tables are uploaded once
     router = Router(rr, RouterOpts(batch_size=args.batch,
-                                   program=args.program))
+                                   program=args.program,
+                                   sweep_budget_div=args.budget_div))
     t0 = time.time()
     res = router.route(term)
     log(f"device warmup route: {time.time() - t0:.1f}s "
@@ -533,6 +539,7 @@ def main():
         "detail": {
             "platform": platform,
             "scale_config": bool(args.scale),
+            "budget_div": int(args.budget_div),
             "luts": int(args.luts),
             "rr_nodes": int(rr.num_nodes),
             "routed": bool(res.success),
