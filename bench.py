@@ -93,9 +93,13 @@ def _recorded_path(args) -> str:
         key = (f"sweep_{args.program}_c{args.sweep_crop}_b{args.batch}"
                f"_g{args.sweep_max_grid}")
     else:
+        # _d suffix only for non-default divs: the default-config key
+        # must stay stable or previously recorded on-chip results would
+        # be orphaned (the replay contract exists to prevent exactly
+        # that failure)
+        div = f"_d{args.budget_div}" if args.budget_div != 1 else ""
         key = (f"scale{int(bool(args.scale))}_l{args.luts}"
-               f"_w{args.chan_width}_{args.program}_b{args.batch}"
-               f"_d{args.budget_div}")
+               f"_w{args.chan_width}_{args.program}_b{args.batch}{div}")
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "bench_tpu", f"{key}.json")
 
