@@ -97,7 +97,9 @@ def _recorded_path(args) -> str:
         # must stay stable or previously recorded on-chip results would
         # be orphaned (the replay contract exists to prevent exactly
         # that failure)
-        div = f"_d{args.budget_div}" if args.budget_div != 1 else ""
+        from parallel_eda_tpu.route import RouterOpts as _RO
+        div = (f"_d{args.budget_div}"
+               if args.budget_div != _RO().sweep_budget_div else "")
         key = (f"scale{int(bool(args.scale))}_l{args.luts}"
                f"_w{args.chan_width}_{args.program}_b{args.batch}{div}")
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -400,12 +402,17 @@ def main():
     ap.add_argument("--moves_per_step", type=int, default=256,
                     help="with --place_only: batched proposals per "
                          "device SA step (M)")
-    ap.add_argument("--budget_div", type=int, default=1,
-                    help="RouterOpts.sweep_budget_div: reduced "
-                         "first-try sweep budgets (1 = off; the "
-                         "at-scale work-efficiency experiment)")
+    ap.add_argument("--budget_div", type=int, default=None,
+                    help="RouterOpts.sweep_budget_div override "
+                         "(default: the library default; 1 forces the "
+                         "full first-try budgets off-setting)")
     args = ap.parse_args()
     serial_error = None
+    if args.budget_div is None:
+        # resolve to the library default up front: replay keys and the
+        # JSON detail must reflect the value that actually runs
+        from parallel_eda_tpu.route import RouterOpts as _RO
+        args.budget_div = _RO().sweep_budget_div
     if args.scale and args.luts == 60:
         args.luts = 1200
         args.chan_width = 20

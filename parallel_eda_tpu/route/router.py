@@ -129,17 +129,19 @@ class RouterOpts:
     # forces that tile regardless of the cost model (tuning/tests).
     # Work per net then scales with its bounding box, not the device
     crop: str = "auto"
-    # EXPERIMENTAL reduced first-try sweep budget (planes program):
-    # 1 = off (budget = bb line-move span, the always-sufficient bound);
-    # d > 1 dispatches each net's first relaxation with span/d sweeps —
-    # most paths need only a few direction changes, so the common case
-    # does ~d times less sweep work.  A net that misses a sink under a
+    # Reduced first-try sweep budget (planes program): 1 = off (budget
+    # = bb line-move span, the always-sufficient bound); d > 1
+    # dispatches each net's first relaxation with span/d sweeps — most
+    # paths need only a few direction changes, so the common case does
+    # ~d times less sweep work.  A net that misses a sink under a
     # reduced budget is PROMOTED to the full budget for the next window
     # instead of taking the unreached->full-device bb widening (the
     # widen_ok gate in planes._step_core); only a full-budget miss
-    # widens.  Work-efficiency lever for the at-scale configs
-    # (BENCHMARKS.md round-5); measured before any default flip.
-    sweep_budget_div: int = 1
+    # widens.  Default 3, measured at 600 LUTs/W=16 on XLA:CPU: relax
+    # steps 14,560 -> 5,824 (2.5x), wall 983 -> 404 s, IDENTICAL
+    # wirelength and window count (BENCHMARKS.md round-5; div=4 gave
+    # 2.9x with the same parity)
+    sweep_budget_div: int = 3
     # wirelength finishing pass (planes program, sink_group=0 only):
     # at first convergence, rip up and re-route EVERYTHING once with
     # the exact incremental sink schedule against the converged

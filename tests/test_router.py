@@ -190,7 +190,10 @@ def test_sweep_budget_div_parity():
     from parallel_eda_tpu.route.check import check_route
 
     f = synth_flow(num_luts=60, chan_width=12, seed=11)
-    r1 = Router(f.rr, RouterOpts(batch_size=32)).route(f.term)
+    # explicit div=1 baseline (the library default is 3 — comparing
+    # defaults would test div=3 against itself)
+    r1 = Router(f.rr, RouterOpts(batch_size=32,
+                                 sweep_budget_div=1)).route(f.term)
     r2 = Router(f.rr, RouterOpts(batch_size=32,
                                  sweep_budget_div=3)).route(f.term)
     assert r1.success and r2.success

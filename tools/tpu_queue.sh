@@ -43,9 +43,10 @@ run crop_sweep 2700 python bench.py --sweep_only --sweep_crop 16 --batch 64
 run crop_pallas_sweep 2700 python bench.py --sweep_only --sweep_crop 16 --program planes_pallas --batch 64
 run default 2700 python bench.py
 run scale 7200 python bench.py --scale --serial_timeout 1800
-# div3 variant skips the (budget-div-independent) serial legs: compare
-# detail.route_time_s against the scale row's device + serial walls
-run scale_div3 7200 python bench.py --scale --skip_serial --budget_div 3
+# div1 variant (reduced budgets OFF) skips the budget-div-independent
+# serial legs: compare detail.route_time_s against the scale row's
+# device + serial walls to measure the lever on-chip
+run scale_div1 7200 python bench.py --scale --skip_serial --budget_div 1
 run place 3600 python bench.py --place_only --luts 1200 --chan_width 20
 run pallas_e2e 2700 python bench.py --program planes_pallas
 # ladder step 3 (BASELINE.md): 10k LUTs, 267k rr nodes, W=20 — placed
