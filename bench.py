@@ -305,15 +305,20 @@ def place_microbench(args) -> None:
 
     opts = PlacerOpts(moves_per_step=args.moves_per_step, seed=3)
     placer = Placer(pnl, grid, opts)
+    from parallel_eda_tpu.obs import compile_seconds, get_metrics
+    c0 = compile_seconds()
     # warmup anneal: populates the compile cache for every sa_segment
     # shape (cold remote compiles on the tunneled TPU take minutes and
     # must not land in the metric of record)
     t0 = time.time()
     placer.place(flow.pos)
     log(f"device warmup anneal: {time.time() - t0:.1f}s")
+    c1 = compile_seconds()
+    get_metrics().reset()        # the measured anneal's snapshots only
     t0 = time.time()
     pos_d, stats = placer.place(flow.pos)
     ddt = time.time() - t0
+    c2 = compile_seconds()
     dev_mps = stats.total_moves / max(ddt, 1e-9)
     log(f"device anneal: {ddt:.1f}s, {stats.total_moves} moves, "
         f"{dev_mps / 1e6:.3f} M moves/s, final bb cost "
@@ -353,7 +358,22 @@ def place_microbench(args) -> None:
                                      if sres else None),
             "serial_error": serial_error,
             "baseline": "native/serial_sa.cc (place.c try_place "
-                        "semantics, -O3, single core)"}})
+                        "semantics, -O3, single core)",
+            # obs rider: temperature count + SA acceptance from the
+            # metrics registry, compile-vs-execute attribution of the
+            # measured anneal (jax.monitoring listener)
+            "obs": {
+                "temps": len(stats.temps),
+                "acceptance_rate_mean": (
+                    round(get_metrics()
+                          .histogram("place.acceptance_rate").mean, 4)
+                    if get_metrics()
+                    .histogram("place.acceptance_rate").count else None),
+                "compile_s_warmup": round(c1 - c0, 3),
+                "compile_s_measured": round(c2 - c1, 3),
+                "execute_s_measured": round(max(0.0, ddt - (c2 - c1)),
+                                            3),
+            }}})
 
 
 def main():
@@ -446,6 +466,14 @@ def main():
             return
         log("TPU unreachable and no recorded on-chip result for this "
             "config; running the CPU fallback (detail.platform=cpu)")
+    # observability riders on every emitted row: the jax.monitoring
+    # compile listener lets the bench split compile from execute time
+    # without wrapping any jit call site, and the metrics registry
+    # carries the per-iteration trajectories
+    from parallel_eda_tpu.obs import enable_compile_capture, get_metrics
+    enable_compile_capture()
+    get_metrics().enabled = True
+
     if args.sweep_only:
         sweep_microbench(args)
         return
@@ -467,14 +495,20 @@ def main():
     router = Router(rr, RouterOpts(batch_size=args.batch,
                                    program=args.program,
                                    sweep_budget_div=args.budget_div))
+    from parallel_eda_tpu.obs import compile_seconds
+    c0 = compile_seconds()
     t0 = time.time()
     res = router.route(term)
     log(f"device warmup route: {time.time() - t0:.1f}s "
         f"(success={res.success}, iters={res.iterations})")
+    c1 = compile_seconds()
 
     t0 = time.time()
     res = router.route(term)
     dt = time.time() - t0
+    c2 = compile_seconds()
+    log(f"compile split: {c1 - c0:.1f}s during warmup, "
+        f"{c2 - c1:.1f}s during the measured route")
     nets_per_sec = res.total_net_routes / dt
     log(f"device route: {dt:.1f}s, {res.total_net_routes} net routes, "
         f"{nets_per_sec:.1f} nets/s, wirelength {res.wirelength}")
@@ -580,6 +614,19 @@ def main():
                                   else None),
             "vs_native_wall": (round(ndt / max(dt, 1e-9), 5)
                                if native else None),
+            # obs rider (obs.metrics / obs.trace): per-iteration
+            # overuse trajectory + compile-vs-execute attribution of
+            # the measured route (warmup absorbs the cold compiles;
+            # any residual measured-run compile means a new program
+            # shape was hit mid-negotiation)
+            "obs": {
+                "route_iterations": int(res.iterations),
+                "overuse_trajectory": [int(s.overused_nodes)
+                                       for s in res.stats],
+                "compile_s_warmup": round(c1 - c0, 3),
+                "compile_s_measured": round(c2 - c1, 3),
+                "execute_s_measured": round(max(0.0, dt - (c2 - c1)), 3),
+            },
         },
     })
 

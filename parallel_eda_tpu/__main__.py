@@ -86,6 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a device profiler trace of routing into "
                    "this dir (xprof/XPlane; view with TensorBoard — the "
                    "reference's VTune/LTTng tracing analogue)")
+    p.add_argument("--trace", default="",
+                   help="write a Chrome trace-event JSON of the whole "
+                   "flow here (per-stage + per-route-iteration spans, "
+                   "JAX compile phases split out; open in Perfetto or "
+                   "chrome://tracing, summarize with "
+                   "tools/trace_report.py — the host-side analogue of "
+                   "the reference's LTTng tp.h tracepoints)")
     p.add_argument("--no_timing", action="store_true",
                    help="congestion-driven only (NO_TIMING algorithm)")
     p.add_argument("--sdc", default="",
@@ -193,6 +200,34 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     check_options(args)
 
+    # observability: one tracer + metrics registry for the whole flow.
+    # The trace must survive failed runs (a routing failure is exactly
+    # when you want the timeline), so export happens in a finally.
+    from .obs import Tracer, get_metrics, set_tracer
+    tracer = None
+    if args.trace:
+        tracer = Tracer()
+        set_tracer(tracer)
+    if args.trace or args.stats_dir:
+        get_metrics().enabled = True
+    try:
+        return _run_flow(args)
+    finally:
+        if args.stats_dir:
+            import os
+            os.makedirs(args.stats_dir, exist_ok=True)
+            mpath = os.path.join(args.stats_dir, "metrics.json")
+            get_metrics().dump(mpath)
+            print(f"metrics snapshots in {mpath}")
+        if tracer is not None:
+            set_tracer(None)
+            tracer.export(args.trace)
+            print(f"trace in {args.trace} (open in Perfetto / "
+                  f"chrome://tracing; summarize with "
+                  f"tools/trace_report.py)")
+
+
+def _run_flow(args) -> int:
     from .arch.builtin import k6_n10_arch, minimal_arch
     from .flow import (FlowResult, binary_search_route, prepare, run_place,
                        run_route, save_artifacts)

@@ -9,7 +9,6 @@ entry points) share one pipeline instead of re-deriving it.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -20,6 +19,7 @@ from .arch.model import Arch
 from .netlist.generate import generate_circuit
 from .netlist.netlist import LogicalNetlist
 from .netlist.packed import PackedNetlist
+from .obs import stage
 from .pack.packer import pack_netlist
 from .place.initial import initial_placement
 from .place.sa import Placer, PlacerOpts, PlaceStats
@@ -47,7 +47,10 @@ class FlowResult:
     route: Optional[RouteResult] = None
     place_stats: Optional[PlaceStats] = None
     bb_factor: int = 3
-    times: dict = field(default_factory=dict)   # stage -> seconds
+    # stage -> seconds: a derived view of the obs stage spans (every
+    # writer goes through obs.stage, so with a tracer installed the
+    # same intervals appear as spans in the trace file)
+    times: dict = field(default_factory=dict)
     sdc: Optional[object] = None    # timing.sdc.SdcConstraints (or None)
 
     @property
@@ -63,10 +66,10 @@ def prepare(nl: LogicalNetlist, arch: Arch, chan_width: int,
     """Front end through initial placement + rr-graph (no SA, no route).
     Pass ``pnl`` to resume from a packed netlist (.net file) instead of
     running the packer."""
-    t0 = time.time()
-    if pnl is None:
-        pnl = pack_netlist(nl, arch)
-    t_pack = time.time() - t0
+    times: dict = {}
+    with stage("pack", times):
+        if pnl is None:
+            pnl = pack_netlist(nl, arch)
     n_io = n_clb = 0
     hard_counts: dict = {}
     for i in range(pnl.num_blocks):
@@ -80,14 +83,12 @@ def prepare(nl: LogicalNetlist, arch: Arch, chan_width: int,
     grid = size_grid(n_clb, n_io, arch, nx=nx, ny=ny,
                      hard_counts=hard_counts)
     pos = initial_placement(pnl, grid, seed=seed)
-    t0 = time.time()
-    rr = build_rr_graph(arch, grid, chan_width=chan_width)
-    t_rr = time.time() - t0
+    with stage("rr_graph", times):
+        rr = build_rr_graph(arch, grid, chan_width=chan_width)
     term = net_terminals(pnl, rr, pos, bb_factor=bb_factor)
     res = FlowResult(arch=arch, nl=nl, pnl=pnl, grid=grid, pos=pos, rr=rr,
                      term=term, bb_factor=bb_factor)
-    res.times["pack"] = t_pack
-    res.times["rr_graph"] = t_rr
+    res.times.update(times)
     return res
 
 
@@ -114,11 +115,10 @@ def run_place_native(flow: FlowResult, seed: int = 7,
     change must re-derive the terminals."""
     from .place.serial_sa import serial_sa_place
 
-    t0 = time.time()
-    res = serial_sa_place(flow.pnl, flow.grid, flow.pos, seed=seed,
-                          inner_num=inner_num)
-    flow.pos = res.pos
-    flow.times["place"] = time.time() - t0
+    with stage("place", flow.times, native=True):
+        res = serial_sa_place(flow.pnl, flow.grid, flow.pos, seed=seed,
+                              inner_num=inner_num)
+        flow.pos = res.pos
     flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,
                               bb_factor=flow.bb_factor)
     return flow
@@ -138,20 +138,19 @@ def run_place(flow: FlowResult,
         from .place.delay_lookup import compute_delay_lookup
         from .place.sa import PlacerTiming
 
-        t0 = time.time()
-        lookup = compute_delay_lookup(flow.rr)
-        flow.times["delay_lookup"] = time.time() - t0
+        with stage("delay_lookup", flow.times):
+            lookup = compute_delay_lookup(flow.rr)
         if flow.tg is None:
             flow.tg = build_timing_graph(flow.nl, flow.pnl, flow.term)
         timing = PlacerTiming(flow.pnl, lookup, flow.term, flow.tg,
                               td_place_exp=opts.td_place_exp)
-    t0 = time.time()
-    from .place.macros import form_macros
-    macros = form_macros(flow.nl, flow.pnl) if flow.nl is not None else []
-    placer = Placer(flow.pnl, flow.grid, opts, timing=timing,
-                    macros=macros)
-    flow.pos, flow.place_stats = placer.place(flow.pos)
-    flow.times["place"] = time.time() - t0
+    with stage("place", flow.times):
+        from .place.macros import form_macros
+        macros = form_macros(flow.nl, flow.pnl) \
+            if flow.nl is not None else []
+        placer = Placer(flow.pnl, flow.grid, opts, timing=timing,
+                        macros=macros)
+        flow.pos, flow.place_stats = placer.place(flow.pos)
     flow.term = net_terminals(flow.pnl, flow.rr, flow.pos,
                               bb_factor=flow.bb_factor)
     return flow
@@ -302,12 +301,11 @@ def run_route(flow: FlowResult, opts: Optional[RouterOpts] = None,
         if flow.analyzer is None:
             flow.analyzer = TimingAnalyzer(flow.tg, sdc=flow.sdc)
     router = Router(flow.rr, opts, mesh=mesh)
-    t0 = time.time()
     # timing-driven: the planes program fuses the per-iteration STA on
     # device (analyzer mode, K>1 windows); ELL falls back to the host cb
-    flow.route = router.route(
-        flow.term, analyzer=flow.analyzer if timing_driven else None)
-    flow.times["route"] = time.time() - t0
+    with stage("route", flow.times, timing_driven=timing_driven):
+        flow.route = router.route(
+            flow.term, analyzer=flow.analyzer if timing_driven else None)
     if timing_driven:
         flow.analyzer.analyze(flow.route.sink_delay)
     if verify and flow.route.success:

@@ -9,6 +9,13 @@ analogue keys records by (window, category) — windows are the unit of
 host-visible work here, the way threads were there — and, like the
 reference's compiled-out log macros (log.h:29-33), the whole subsystem
 is a no-op unless a sink directory is configured.
+
+MdcLogger is a context manager: the router holds its negotiation inside
+``with MdcLogger(...) as mlog:`` so an exception mid-negotiation can
+never leak open per-window file handles.  Records are stamped on
+time.perf_counter against a caller-supplied origin — pass the tracer's
+t0 (obs.trace.Tracer.t0) and mdclog ``t`` values are directly
+comparable with span timestamps in the same run's trace file.
 """
 
 from __future__ import annotations
@@ -27,17 +34,27 @@ class MdcLogger:
 
     ``set_mdc(window=...)`` routes subsequent records to
     <dir>/logs/window_<w>/<category>.log (zlog_put_mdc semantics); each
-    record is one JSON line with a monotonic timestamp."""
+    record is one JSON line with a monotonic timestamp.  ``t0`` is the
+    perf_counter origin for those timestamps (defaults to construction
+    time); give it the active tracer's t0 to share the trace clock."""
 
-    def __init__(self, base_dir: Optional[str] = None):
+    def __init__(self, base_dir: Optional[str] = None,
+                 t0: Optional[float] = None):
         self.base_dir = base_dir
         self._window = 0
         self._files = {}
-        self._t0 = time.monotonic()
+        self._t0 = time.perf_counter() if t0 is None else t0
 
     @property
     def enabled(self) -> bool:
         return self.base_dir is not None
+
+    def __enter__(self) -> "MdcLogger":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     def set_mdc(self, window: int) -> None:
         if self._window != window:
@@ -56,7 +73,7 @@ class MdcLogger:
             os.makedirs(d, exist_ok=True)
             f = open(os.path.join(d, f"{category}.log"), "a")
             self._files[category] = f
-        record["t"] = round(time.monotonic() - self._t0, 6)
+        record["t"] = round(time.perf_counter() - self._t0, 6)
         f.write(json.dumps(record) + "\n")
         f.flush()
 

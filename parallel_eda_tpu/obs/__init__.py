@@ -1,0 +1,36 @@
+"""Unified observability: span tracing + metrics registry.
+
+The reference's parallel-router work was debuggable because of its
+instrumentation layer — zlog/MDC structured logs per (iteration, thread)
+and LTTng tracepoints (parallel_route/tp.h) feeding Trace Compass.  This
+package is the TPU-flow analogue, one instrumentation surface with three
+sinks:
+
+  trace.py    — span-based tracer -> Chrome trace-event JSON, viewable
+                in Perfetto / chrome://tracing (the tp.h analogue); JAX
+                compile phases are captured as their own spans so XLA
+                compilation is separable from iteration timings
+  metrics.py  — counters/gauges/histograms snapshotted per iteration
+                (router overuse, relax steps, SA temperature/acceptance,
+                STA crit-path trajectory), dumpable as JSON next to the
+                mdclog sinks
+  ../mdclog.py — the existing per-(window, category) structured logs,
+                now sharing the tracer's clock so records line up with
+                span timestamps
+
+Everything is a no-op unless explicitly enabled (set_tracer /
+MetricsRegistry.enabled), like the reference's compiled-out log macros
+(log.h:29-33).  See OBSERVABILITY.md at the repo root.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_metrics, set_metrics)
+from .trace import (Tracer, compile_seconds, enable_compile_capture,
+                    get_tracer, set_tracer, span, stage)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_metrics", "set_metrics",
+    "Tracer", "compile_seconds", "enable_compile_capture",
+    "get_tracer", "set_tracer", "span", "stage",
+]

@@ -1,0 +1,162 @@
+"""Metrics registry: counters / gauges / histograms + per-iteration
+snapshots.
+
+The reference accumulated router counters in perf_t (route.h:12-20:
+heap pops/visits/pushes per thread) and printed them into the
+<circuit>_stats_N/ files; the placer logged per-temperature rows from
+try_place.  This registry is the shared, queryable version: every layer
+registers named instruments on one registry, the driver snapshots them
+at iteration boundaries, and the whole trajectory dumps as JSON next to
+the mdclog sinks (stats_dir/metrics.json).
+
+Instruments are always safe to update (a set/inc is a float store);
+only snapshot() is gated on `enabled`, so an un-instrumented run keeps
+no per-iteration history and allocates nothing beyond the instrument
+objects themselves.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotone event count (relax steps, net routes, checkpoints)."""
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-value instrument (overuse count, pres_fac, temperature)."""
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max) — enough for acceptance
+    rates and span-size distributions without unbounded storage."""
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else float("nan")
+
+    def summary(self) -> dict:
+        return {"count": self.count, "sum": self.total,
+                "min": self.min, "max": self.max,
+                "mean": self.mean if self.count else None}
+
+
+class MetricsRegistry:
+    """Named instruments + an append-only list of labeled snapshots."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self.snapshots: List[dict] = []
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = Counter()
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge()
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            h = self._hists[name] = Histogram()
+        return h
+
+    def values(self, prefix: str = "") -> dict:
+        """Current value of every instrument (histograms summarized)."""
+        out = {}
+        for n, c in self._counters.items():
+            if n.startswith(prefix):
+                out[n] = c.value
+        for n, g in self._gauges.items():
+            if n.startswith(prefix):
+                out[n] = g.value
+        for n, h in self._hists.items():
+            if n.startswith(prefix):
+                out[n] = h.summary()
+        return out
+
+    def snapshot(self, **labels) -> Optional[dict]:
+        """Record the current instrument values under labels (e.g.
+        phase="route", iteration=7).  No-op unless enabled — the
+        per-iteration history is an opt-in cost."""
+        if not self.enabled:
+            return None
+        snap = {"labels": labels, "values": self.values()}
+        self.snapshots.append(snap)
+        return snap
+
+    def series(self, name: str, **match) -> list:
+        """The trajectory of one instrument across snapshots whose
+        labels contain `match` (e.g. series("route.overused_nodes",
+        phase="route"))."""
+        out = []
+        for s in self.snapshots:
+            if all(s["labels"].get(k) == v for k, v in match.items()):
+                if name in s["values"]:
+                    out.append(s["values"][name])
+        return out
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"values": self.values(),
+                       "snapshots": self.snapshots}, f, indent=1)
+
+    def reset(self) -> None:
+        self._counters.clear()
+        self._gauges.clear()
+        self._hists.clear()
+        self.snapshots.clear()
+
+
+# process-wide registry: layers update it unconditionally (cheap);
+# snapshots accumulate only once a driver (CLI --trace/--stats_dir,
+# bench.py, tests) flips .enabled
+_registry = MetricsRegistry()
+
+
+def get_metrics() -> MetricsRegistry:
+    return _registry
+
+
+def set_metrics(reg: MetricsRegistry) -> MetricsRegistry:
+    global _registry
+    _registry = reg
+    return reg

@@ -1,0 +1,221 @@
+"""Span-based tracer exporting Chrome trace-event JSON.
+
+The reference instrumented its routers with LTTng tracepoints
+(parallel_route/tp.h: route_start/route_end, net_route, heap ops) and
+viewed them in Trace Compass; the TPU flow's equivalent view is the
+Chrome trace-event format, openable in Perfetto (ui.perfetto.dev) or
+chrome://tracing.  Spans are complete ("X") events with microsecond
+timestamps from one process-wide perf_counter origin, so mdclog records
+stamped from the same origin (MdcLogger t0) line up exactly.
+
+Two things the tp.h design could not give us come for free here:
+
+- compile vs execute: jax.monitoring publishes per-phase compilation
+  durations (/jax/core/compile/*); the listener turns each into a
+  "jax.compile.*" span, so XLA compilation — minutes on the tunneled
+  TPU — is separable from iteration timings instead of polluting the
+  first window of every route.
+- disabled = no-op: with no tracer installed, span() hands back one
+  shared null context and does nothing else (no allocation, no file,
+  no clock read), like the reference's compiled-out log macros.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+
+class _NullSpan:
+    """Shared do-nothing context: the disabled-tracer fast path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "cat", "args", "_t_in")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t_in = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t = time.perf_counter()
+        self.tracer.add_complete(self.name, self._t_in, t - self._t_in,
+                                 cat=self.cat, **self.args)
+        return False
+
+
+class Tracer:
+    """In-memory span recorder; export() writes the trace-event file.
+
+    All timestamps are seconds on time.perf_counter relative to the
+    tracer's t0 (converted to µs at export).  Thread-safe appends; tid
+    is the OS thread ident so Perfetto draws one track per thread.
+    """
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.events: list = []
+        self._lock = threading.Lock()
+
+    def span(self, name: str, cat: str = "flow", **args) -> _Span:
+        return _Span(self, name, cat, args)
+
+    def add_complete(self, name: str, t_abs: float, dur: float,
+                     cat: str = "flow", **args) -> None:
+        """Record a complete event from absolute perf_counter seconds."""
+        ev = {"name": name, "ph": "X", "cat": cat,
+              "ts": (t_abs - self.t0) * 1e6, "dur": max(0.0, dur) * 1e6,
+              "pid": 1, "tid": threading.get_ident() & 0x7FFFFFFF}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    def instant(self, name: str, cat: str = "flow", **args) -> None:
+        ev = {"name": name, "ph": "i", "cat": cat, "s": "t",
+              "ts": (time.perf_counter() - self.t0) * 1e6,
+              "pid": 1, "tid": threading.get_ident() & 0x7FFFFFFF}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self.events.append(ev)
+
+    def total(self, name_prefix: str) -> float:
+        """Sum of span durations (seconds) whose name starts with
+        name_prefix — e.g. total("jax.compile") for the compile split."""
+        with self._lock:
+            return sum(e.get("dur", 0.0) for e in self.events
+                       if e["ph"] == "X"
+                       and e["name"].startswith(name_prefix)) / 1e6
+
+    def export(self, path: str) -> None:
+        with self._lock:
+            evs = sorted(self.events, key=lambda e: e["ts"])
+        meta = [{"name": "process_name", "ph": "M", "pid": 1, "ts": 0,
+                 "args": {"name": "parallel_eda_tpu"}}]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": meta + evs,
+                       "displayTimeUnit": "ms"}, f)
+
+
+# ---- process-wide tracer + the disabled fast path ----
+
+_tracer: Optional[Tracer] = None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    """Install (or clear, with None) the process tracer.  Installing a
+    real tracer also hooks the JAX compile-phase listener."""
+    global _tracer
+    _tracer = tracer
+    if tracer is not None:
+        enable_compile_capture()
+
+
+def span(name: str, cat: str = "flow", **args):
+    """`with span("route.iter", it=3):` — records a complete event on
+    the installed tracer; a shared no-op context when tracing is off."""
+    t = _tracer
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, cat=cat, **args)
+
+
+class _StageCtx:
+    """span() that ALSO writes its duration into a stage->seconds dict
+    (FlowResult.times compatibility: the dict becomes a derived view of
+    the spans instead of a parallel ad-hoc time.time() ledger)."""
+    __slots__ = ("name", "times", "inner", "_t_in")
+
+    def __init__(self, name: str, times: Optional[dict], inner):
+        self.name = name
+        self.times = times
+        self.inner = inner
+
+    def __enter__(self):
+        self._t_in = time.perf_counter()
+        self.inner.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        r = self.inner.__exit__(*exc)
+        if self.times is not None:
+            self.times[self.name] = time.perf_counter() - self._t_in
+        return r
+
+
+def stage(name: str, times: Optional[dict] = None, **args) -> _StageCtx:
+    """Flow-stage span ("pack", "place", "route", ...) that keeps the
+    legacy times dict populated with the same clock."""
+    return _StageCtx(name, times, span(name, cat="stage", **args))
+
+
+# ---- JAX compile-phase capture (/jax/core/compile/* monitoring) ----
+
+_compile_s = 0.0
+_capture_on = False
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    if not event.startswith("/jax/core/compile/"):
+        return
+    global _compile_s
+    _compile_s += duration
+    t = _tracer
+    if t is not None:
+        # the listener fires at phase END with only a duration: anchor
+        # the span backwards from now (the phase ran synchronously, so
+        # it nests inside whatever host span is open)
+        name = event.rsplit("/", 1)[1]
+        if name.endswith("_duration"):
+            name = name[: -len("_duration")]
+        t.add_complete("jax.compile." + name,
+                       time.perf_counter() - duration, duration,
+                       cat="jax.compile")
+
+
+def enable_compile_capture() -> None:
+    """Register the jax.monitoring duration listener (once).  Safe to
+    call without a tracer: the listener then only feeds the process
+    compile-seconds accumulator (compile_seconds()), which bench rows
+    use for their compile-vs-execute attribution."""
+    global _capture_on
+    if _capture_on:
+        return
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _capture_on = True
+    except Exception:
+        # no jax in this interpreter (tools, docs builds): tracing of
+        # host spans still works, there is just nothing to compile
+        pass
+
+
+def compile_seconds() -> float:
+    """Total JAX compile-phase seconds observed since capture was
+    enabled (monotone; diff around a region to attribute it)."""
+    return _compile_s

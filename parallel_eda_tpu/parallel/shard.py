@@ -38,6 +38,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import get_metrics, span
 from ..route.device_graph import DeviceRRGraph
 from ..route.search import route_and_commit
 
@@ -170,16 +171,29 @@ class ShardedRouter:
         if B % n_net:
             raise ValueError(f"batch {B} not divisible by net axis "
                              f"{n_net}")
-        put = jax.device_put
-        prev_paths = put(prev_paths, self.s_batch)
-        source = put(source, self.s_batch)
-        sinks = put(sinks, self.s_batch)
-        bb = put(bb, self.s_batch)
-        crit = put(crit, self.s_batch)
-        net_key = put(net_key, self.s_batch)
-        valid = put(valid, self.s_batch)
-        occ = put(occ, self.s_node)
-        acc = put(acc, self.s_node)
-        return route_and_commit(
-            dev, occ, acc, pres_fac, prev_paths, source, sinks, bb, crit,
-            net_key, valid, max_steps, max_len, num_waves, group)
+        # per-device-step telemetry: the span covers shard placement +
+        # dispatch (the device work itself is async; a following fetch
+        # shows as the caller's sync time), the gauges record the mesh
+        # decomposition every step ran under
+        reg = get_metrics()
+        reg.counter("shard.route_steps").inc()
+        reg.gauge("shard.batch_per_device").set(B // n_net)
+        reg.gauge("shard.mesh_net").set(int(n_net))
+        reg.gauge("shard.mesh_node").set(int(self.mesh.shape[NODE]))
+        with span("shard.route_step", cat="parallel", batch=int(B),
+                  net_axis=int(n_net),
+                  node_axis=int(self.mesh.shape[NODE])):
+            put = jax.device_put
+            prev_paths = put(prev_paths, self.s_batch)
+            source = put(source, self.s_batch)
+            sinks = put(sinks, self.s_batch)
+            bb = put(bb, self.s_batch)
+            crit = put(crit, self.s_batch)
+            net_key = put(net_key, self.s_batch)
+            valid = put(valid, self.s_batch)
+            occ = put(occ, self.s_node)
+            acc = put(acc, self.s_node)
+            return route_and_commit(
+                dev, occ, acc, pres_fac, prev_paths, source, sinks, bb,
+                crit, net_key, valid, max_steps, max_len, num_waves,
+                group)

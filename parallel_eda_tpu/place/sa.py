@@ -49,6 +49,7 @@ import numpy as np
 from flax import struct
 
 from ..netlist.packed import PackedNetlist
+from ..obs import get_metrics, span
 from ..rr.grid import DeviceGrid
 
 # VPR's expected-crossing-count correction for the linear-congestion bb cost
@@ -847,29 +848,32 @@ class Placer:
                 crit, _ = self._crit(np.asarray(pos))
             n_temps = min(SEG, opts.max_temps - temp_i)
             key, k = jax.random.split(key)
-            (pos, ring, occ, t_d, rlim_d, na_a, nv_a, bb_a, live_a,
-             ts_a, rl_a) = sa_segment(
-                pp, pos, ring, occ, crit, tt, k,
-                jnp.float32(t), jnp.float32(rlim),
-                jnp.float32(exit_t), M, steps, n_temps,
-                self.timing is not None)
-            # rigid macro relocations ride along once per segment
-            # (place_macro.c try_swap-for-macros; async dispatches)
-            if self._mac_blocks is not None:
-                Lm = int(self._mac_blocks.shape[1])
-                Mm = min(32, max(4, len(self.macros)))
-                inv_bb_m = jnp.float32(1.0 / max(bb_cost, 1e-30))
-                for _ in range(4):
-                    key, k2 = jax.random.split(key)
-                    pos, ring, occ, _ = macro_step(
-                        pp, self._mac_blocks, self._mac_len, pos, ring,
-                        occ, k2, jnp.float32(t), jnp.float32(rlim),
-                        inv_bb_m, Mm, Lm)
-            # ONE host sync per segment
-            t, rlim, na_a, nv_a, bb_a, live_a, ts_a, rl_a = \
-                jax.device_get((t_d, rlim_d, na_a, nv_a, bb_a, live_a,
-                                ts_a, rl_a))
+            with span("place.segment", cat="place", n_temps=n_temps,
+                      t=float(t)):
+                (pos, ring, occ, t_d, rlim_d, na_a, nv_a, bb_a, live_a,
+                 ts_a, rl_a) = sa_segment(
+                    pp, pos, ring, occ, crit, tt, k,
+                    jnp.float32(t), jnp.float32(rlim),
+                    jnp.float32(exit_t), M, steps, n_temps,
+                    self.timing is not None)
+                # rigid macro relocations ride along once per segment
+                # (place_macro.c try_swap-for-macros; async dispatches)
+                if self._mac_blocks is not None:
+                    Lm = int(self._mac_blocks.shape[1])
+                    Mm = min(32, max(4, len(self.macros)))
+                    inv_bb_m = jnp.float32(1.0 / max(bb_cost, 1e-30))
+                    for _ in range(4):
+                        key, k2 = jax.random.split(key)
+                        pos, ring, occ, _ = macro_step(
+                            pp, self._mac_blocks, self._mac_len, pos,
+                            ring, occ, k2, jnp.float32(t),
+                            jnp.float32(rlim), inv_bb_m, Mm, Lm)
+                # ONE host sync per segment
+                t, rlim, na_a, nv_a, bb_a, live_a, ts_a, rl_a = \
+                    jax.device_get((t_d, rlim_d, na_a, nv_a, bb_a,
+                                    live_a, ts_a, rl_a))
             t, rlim = float(t), float(rlim)
+            reg = get_metrics()
             for i in range(n_temps):
                 if live_a[i] == 0.0:
                     break
@@ -877,6 +881,18 @@ class Placer:
                 stats.temps.append((float(ts_a[i]), float(bb_a[i]), srat,
                                     float(rl_a[i])))
                 stats.total_moves += int(nv_a[i])
+                # per-temperature telemetry (try_place's per-temp print
+                # row as registry instruments; snapshots give the full
+                # schedule trajectory)
+                reg.gauge("place.t").set(float(ts_a[i]))
+                reg.gauge("place.bb_cost").set(float(bb_a[i]))
+                reg.gauge("place.success_rate").set(srat)
+                reg.gauge("place.rlim").set(float(rl_a[i]))
+                reg.counter("place.moves").inc(int(nv_a[i]))
+                reg.counter("place.accepted_moves").inc(int(na_a[i]))
+                reg.histogram("place.acceptance_rate").record(srat)
+                reg.snapshot(phase="place",
+                             temperature=len(stats.temps) - 1)
             temp_i += n_temps
             bb_cost = float(bb_a[-1])
             # exit_crit (place.c:270) on the normalized combined cost
@@ -897,6 +913,13 @@ class Placer:
             if self.timing is not None else 0.0
         if self.timing is not None:
             _, stats.est_crit_path = self._crit(np.asarray(pos))
+        reg = get_metrics()
+        reg.gauge("place.final_cost").set(stats.final_cost)
+        reg.gauge("place.total_moves").set(int(stats.total_moves))
+        if stats.est_crit_path == stats.est_crit_path:
+            reg.gauge("place.est_crit_path").set(
+                float(stats.est_crit_path))
+        reg.snapshot(phase="place_final", temps=len(stats.temps))
         # final legality audit (check_place, place.c:253): an annealer
         # bug must never hand the router an illegal placement silently
         from .check import check_place

@@ -14,6 +14,19 @@ import numpy as np
 from ..rr.graph import CHANX, CHANY, RRGraph
 
 
+def overused_wire_nodes(rr: RRGraph, occ: np.ndarray) -> int:
+    """Count of WIRE nodes (CHANX/CHANY) over capacity.  stats.c counts
+    overuse on routing wires only — SOURCE/SINK/pin nodes are not
+    fabric resources — so both the human-readable report and the
+    metrics registry (obs.metrics 'route.overused_wire_nodes') go
+    through this one helper and cannot drift."""
+    occ = np.asarray(occ)
+    nt = np.asarray(rr.node_type)
+    wire = (nt == CHANX) | (nt == CHANY)
+    over = occ - np.asarray(rr.capacity, dtype=np.int64)
+    return int(((over > 0) & wire).sum())
+
+
 def route_report(rr: RRGraph, occ: np.ndarray,
                  num_nets: int) -> str:
     """Human-readable routing statistics block."""
@@ -50,9 +63,9 @@ def route_report(rr: RRGraph, occ: np.ndarray,
         lines.append(f"  segment cost_index {int(c)} (len<={L}): "
                      f"{u}/{int(m.sum())} wires used")
 
-    # occupancy histogram: how contested the fabric is
-    over = occ - np.asarray(rr.capacity, dtype=np.int64)
-    lines.append(f"  overused nodes: {int((over > 0).sum())}")
+    # occupancy histogram: how contested the fabric is (wire nodes
+    # only, stats.c semantics — see overused_wire_nodes)
+    lines.append(f"  overused nodes: {overused_wire_nodes(rr, occ)}")
     return "\n".join(lines)
 
 

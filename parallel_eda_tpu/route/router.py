@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import get_metrics, get_tracer
 from ..rr.graph import RRGraph
 from ..rr.terminals import NetTerminals
 from .device_graph import DeviceRRGraph, to_device
@@ -441,6 +442,61 @@ class Router:
                         f.write(f"{r} {s}: " +
                                 " ".join(str(v) for v in seg) + "\n")
 
+    @staticmethod
+    def _obs_window(tw0: float, it_done: int, K: int, n_over: int,
+                    over_total: int, rerouted: int, relax_steps: int,
+                    pres: float, cpd: float, batches: int) -> None:
+        """Trace + metrics for one committed window: a route.window
+        span, K route.iter child spans, and the per-iteration registry
+        snapshot.  Iteration boundaries inside a K>1 fused window are
+        not host-visible, so the window's wall time is attributed
+        evenly across its iterations and the spans carry approx=True —
+        the stats_dir / host-callback paths force K=1 and get exact
+        per-iteration spans."""
+        tw1 = time.perf_counter()
+        tr = get_tracer()
+        if tr is not None:
+            tr.add_complete(
+                "route.window", tw0, tw1 - tw0, cat="route",
+                first_iter=it_done - K + 1, last_iter=it_done, K=K,
+                rerouted=rerouted, overused_nodes=n_over,
+                relax_steps=relax_steps)
+            dt = (tw1 - tw0) / max(1, K)
+            for j in range(K):
+                tr.add_complete("route.iter", tw0 + j * dt, dt,
+                                cat="route", it=it_done - K + 1 + j,
+                                overused=int(n_over),
+                                pres_fac=round(float(pres), 4),
+                                approx=K > 1)
+        reg = get_metrics()
+        reg.counter("route.iterations").inc(K)
+        reg.counter("route.relax_steps").inc(relax_steps)
+        reg.counter("route.batches").inc(batches)
+        reg.gauge("route.overused_nodes").set(int(n_over))
+        reg.gauge("route.overuse_total").set(int(over_total))
+        reg.gauge("route.dirty_nets").set(int(rerouted))
+        reg.gauge("route.pres_fac").set(round(float(pres), 6))
+        if cpd == cpd:
+            reg.gauge("route.crit_path_delay").set(float(cpd))
+        reg.histogram("route.window_wall_s").record(tw1 - tw0)
+        reg.snapshot(phase="route", iteration=int(it_done))
+
+    def _obs_final(self, result: "RouteResult") -> None:
+        """End-of-route registry state: the converged numbers every
+        report derives from.  overused_wire_nodes uses the SAME helper
+        as route_report, so the metrics sink and the human-readable
+        report cannot drift (stats.c wire-only overuse semantics)."""
+        from .report import overused_wire_nodes
+
+        reg = get_metrics()
+        reg.gauge("route.success").set(bool(result.success))
+        reg.gauge("route.wirelength").set(int(result.wirelength))
+        reg.gauge("route.widened_nets").set(int(result.widened_nets))
+        reg.gauge("route.net_routes").set(int(result.total_net_routes))
+        reg.gauge("route.overused_wire_nodes").set(
+            overused_wire_nodes(self.rr, result.occ))
+        reg.snapshot(phase="route_final", iteration=result.iterations)
+
     def _lb_scale(self):
         """[4] scale vector for the windowed A* gate: flat (congestion,
         delay) per-tile floors x astar_fac, astar_fac itself (applied
@@ -500,7 +556,8 @@ class Router:
                               occ, acc,
                               paths, sink_delay, all_reached, bb, full_bb,
                               source_d, sinks_d, planes_tbl, nsinks_np,
-                              cx_np, cy_np, result, B, resume=None):
+                              cx_np, cy_np, result, B, mlog,
+                              resume=None):
         """Window-fused PathFinder driver for the planes program: the
         negotiation runs as a sequence of multi-iteration device programs
         (planes.route_window_planes) with ONE host sync per window — the
@@ -611,12 +668,6 @@ class Router:
         L_cap = self.max_len
         next_ckpt = (it_done + opts.checkpoint_every
                      if opts.checkpoint_every else None)
-        # structured per-(window, category) logging (zlog/MDC
-        # equivalent, parallel_route/log.cxx:40-68): no-op unless a
-        # stats_dir sink is configured, like the reference's
-        # compiled-out log macros
-        from ..mdclog import MdcLogger
-        mlog = MdcLogger(opts.stats_dir)
         # static initial bbs (terminal extent + bb_factor): the crop
         # anchor — tiles must cover a net's terminals even after its
         # LIVE bb widens device-side (see _step_core crop notes)
@@ -805,6 +856,7 @@ class Router:
                 return out, waves * nsw
 
             t0 = time.time()
+            tw0 = time.perf_counter()
             w_steps = 0
             w_steps_crop = 0
             nroutes_w = 0
@@ -886,6 +938,8 @@ class Router:
                 batches=int(nexec),
                 overuse_pct=100.0 * n_over / max(1, N),
                 crit_path_delay=cpd))
+            self._obs_window(tw0, it_done, K, n_over, over_total,
+                             len(dirty), w_steps, pres, cpd, int(nexec))
             if analyzer is not None and cpd == cpd:
                 analyzer.crit_path_delay = cpd
             if mlog.enabled:
@@ -1039,11 +1093,11 @@ class Router:
             occ, paths, sink_delay, all_reached, bb, fin_it = fin_save
             result.success = True
             result.iterations = fin_it
-        mlog.close()
         result.wirelength = int(wirelength_on_device(dev, paths))
         result.paths = np.asarray(paths)
         result.sink_delay = np.asarray(sink_delay)
         result.occ = np.asarray(occ)
+        self._obs_final(result)
         if opts.stats_dir:
             write_stats_files(opts.stats_dir, result)
             from .report import write_route_report
@@ -1201,11 +1255,21 @@ class Router:
         pres_fac = opts.initial_pres_fac
         result = RouteResult(False, 0, None, None, None, 0)
         if self.pg is not None:
-            return self._route_planes_windows(
-                term, crit, timing_cb, analyzer, occ, acc, paths,
-                sink_delay, all_reached, bb, full_bb, source_d, sinks_d,
-                planes_tbl, nsinks_np, cx_np, cy_np, result, B,
-                resume=resume)
+            # structured per-(window, category) logging (zlog/MDC
+            # equivalent, parallel_route/log.cxx:40-68): no-op unless a
+            # stats_dir sink is configured.  Context-managed so an
+            # exception mid-negotiation cannot leak open per-window
+            # file handles; sharing the tracer's clock makes mdclog `t`
+            # values directly comparable with span timestamps
+            from ..mdclog import MdcLogger
+            tr = get_tracer()
+            with MdcLogger(opts.stats_dir,
+                           t0=tr.t0 if tr is not None else None) as mlog:
+                return self._route_planes_windows(
+                    term, crit, timing_cb, analyzer, occ, acc, paths,
+                    sink_delay, all_reached, bb, full_bb, source_d,
+                    sinks_d, planes_tbl, nsinks_np, cx_np, cy_np,
+                    result, B, mlog, resume=resume)
         if win is not None:
             result.windowed_nets = int((~wide).sum())
         n_over = -1                      # previous iteration's overuse
@@ -1220,6 +1284,7 @@ class Router:
 
         for it in range(1, opts.max_router_iterations + 1):
             t0 = time.time()
+            tw0 = time.perf_counter()
             if it <= opts.incremental_after:
                 idx = np.arange(R)
             else:
@@ -1353,6 +1418,9 @@ class Router:
                 it, n_over, over_total, len(idx), time.time() - t0,
                 relax_steps=it_steps, batches=len(batches),
                 overuse_pct=100.0 * n_over / max(1, N)))
+            self._obs_window(tw0, it, 1, n_over, over_total, len(idx),
+                             it_steps, pres_fac, float("nan"),
+                             len(batches))
 
             if opts.stats_dir and opts.dump_routes:
                 self._dump_routes(opts.stats_dir, it, np.asarray(paths), N)
@@ -1380,6 +1448,7 @@ class Router:
         result.paths = np.asarray(paths)
         result.sink_delay = np.asarray(sink_delay)
         result.occ = np.asarray(occ)
+        self._obs_final(result)
         if opts.stats_dir:
             write_stats_files(opts.stats_dir, result)
             from .report import write_route_report

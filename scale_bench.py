@@ -88,8 +88,11 @@ def main():
 
     # ---- 2. end-to-end large route ----
     from parallel_eda_tpu.flow import run_place, run_route, synth_flow
+    from parallel_eda_tpu.obs import compile_seconds, enable_compile_capture
     from parallel_eda_tpu.place import PlacerOpts
     from parallel_eda_tpu.route import RouterOpts
+
+    enable_compile_capture()
 
     if args.memory_only:
         f = synth_flow(num_luts=120, num_inputs=16, num_outputs=16,
@@ -107,10 +110,12 @@ def main():
         f = run_place(f, PlacerOpts(moves_per_step=256), timing_driven=False)
         t_place = time.time() - t0
         log(f"placed in {t_place:.0f}s")
+        c0 = compile_seconds()
         t0 = time.time()
         f = run_route(f, RouterOpts(batch_size=args.batch),
                       timing_driven=False)
         t_route = time.time() - t0
+        c_route = compile_seconds() - c0
         res = f.route
         R, S = f.term.sinks.shape
         print(f"- circuit: {args.big} LUTs, {R} nets (Smax {S}), "
@@ -122,6 +127,11 @@ def main():
               f"{res.total_net_routes} net-routes "
               f"({res.total_net_routes/t_route:.1f} nets/s)")
         print(f"- legality: verified by the independent checker (run_route)")
+        print(f"- obs: {res.iterations} route iterations, overuse "
+              f"trajectory {[s.overused_nodes for s in res.stats]}, "
+              f"compile {c_route:.1f}s / execute "
+              f"{max(0.0, t_route - c_route):.1f}s of the route wall "
+              f"(jax.monitoring split; cold run = mostly compile)")
         print("- iteration stats (window syncs):")
         print("  | iter | overused | overuse total | dirty nets |")
         print("  |---|---|---|---|")

@@ -33,6 +33,39 @@ def test_mdc_routing(tmp_path):
     assert json.loads(p2.read_text().strip())["iteration"] == 4
 
 
+def test_context_manager_closes_files(tmp_path):
+    with MdcLogger(str(tmp_path)) as log:
+        log.set_mdc(window=1)
+        log.log("route", iteration=1)
+        assert log._files
+    assert not log._files                 # __exit__ closed every sink
+
+
+def test_context_manager_closes_on_exception(tmp_path):
+    with pytest.raises(RuntimeError):
+        with MdcLogger(str(tmp_path)) as log:
+            log.set_mdc(window=1)
+            log.log("route", iteration=1)
+            raise RuntimeError("mid-negotiation failure")
+    assert not log._files                 # no leaked handles
+    p = tmp_path / "logs" / "window_1" / "route.log"
+    assert json.loads(p.read_text().strip())["iteration"] == 1
+
+
+def test_shared_clock_origin(tmp_path):
+    """t0 injection: records are stamped against the caller's origin
+    (the tracer's t0), so mdclog `t` values line up with trace spans."""
+    import time
+
+    origin = time.perf_counter() - 100.0  # pretend the run began 100s ago
+    with MdcLogger(str(tmp_path), t0=origin) as log:
+        log.set_mdc(window=1)
+        log.log("route", iteration=1)
+    p = tmp_path / "logs" / "window_1" / "route.log"
+    t = json.loads(p.read_text().strip())["t"]
+    assert t >= 100.0
+
+
 def test_unknown_category_rejected(tmp_path):
     log = MdcLogger(str(tmp_path))
     with pytest.raises(ValueError):

@@ -1,0 +1,266 @@
+"""Unified observability subsystem (obs/): span tracer + Chrome trace
+export, metrics registry, JAX compile capture, --trace CLI surface,
+tools/trace_report.py validation."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from parallel_eda_tpu.obs import (MetricsRegistry, Tracer, get_metrics,
+                                  set_metrics, set_tracer, span, stage)
+from parallel_eda_tpu.obs.trace import _NULL_SPAN
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location("trace_report",
+                                                  TRACE_REPORT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Each test gets (and leaves behind) pristine process-wide obs
+    state: no tracer, a fresh disabled registry."""
+    set_tracer(None)
+    set_metrics(MetricsRegistry())
+    yield
+    set_tracer(None)
+    set_metrics(MetricsRegistry())
+
+
+# ---- tracer ----
+
+def test_span_nesting_roundtrip(tmp_path):
+    tr = Tracer()
+    set_tracer(tr)
+    with span("outer", cat="stage", label="x"):
+        with span("inner", cat="route", it=3):
+            pass
+        with span("inner2"):
+            pass
+    tr.instant("mark", note="here")
+    p = tmp_path / "t.json"
+    tr.export(str(p))
+
+    doc = json.loads(p.read_text())
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert set(xs) == {"outer", "inner", "inner2"}
+    outer, inner = xs["outer"], xs["inner"]
+    # nesting: child contained in parent, µs timestamps, args kept
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+    assert outer["args"] == {"label": "x"}
+    assert inner["args"] == {"it": 3}
+    assert inner["cat"] == "route"
+    # export sorts by ts and every X event has a nonnegative dur
+    ts = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    assert all(e["dur"] >= 0 for e in evs if e["ph"] == "X")
+    assert any(e["ph"] == "i" and e["name"] == "mark" for e in evs)
+    # and the validator agrees it is well-formed
+    assert _load_trace_report().validate(doc) == []
+
+
+def test_stage_writes_times_dict():
+    tr = Tracer()
+    set_tracer(tr)
+    times = {}
+    with stage("pack", times):
+        pass
+    assert times["pack"] >= 0.0
+    assert tr.total("pack") >= 0.0
+    # stage() keeps the legacy dict populated even with tracing off
+    set_tracer(None)
+    with stage("route", times):
+        pass
+    assert "route" in times
+
+
+def test_disabled_path_is_true_noop():
+    assert span("anything", it=1) is _NULL_SPAN
+    assert span("other") is span("different")     # one shared singleton
+    with span("nested"):
+        with span("deeper"):
+            pass                                  # no tracer, no effect
+
+
+# ---- metrics ----
+
+def test_metrics_registry_shapes():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("route.iterations").inc(3)
+    reg.gauge("route.pres_fac").set(1.3)
+    reg.histogram("route.window_wall_s").record(0.5)
+    reg.histogram("route.window_wall_s").record(1.5)
+    assert reg.counter("route.iterations").value == 3
+    h = reg.histogram("route.window_wall_s")
+    assert h.count == 2 and h.mean == 1.0 and h.min == 0.5 and h.max == 1.5
+
+    v = reg.values()
+    assert v["route.iterations"] == 3
+    assert v["route.pres_fac"] == 1.3
+    assert v["route.window_wall_s"]["count"] == 2
+    assert set(reg.values(prefix="route.pres")) == {"route.pres_fac"}
+
+    s = reg.snapshot(phase="route", iteration=1)
+    assert s["labels"] == {"phase": "route", "iteration": 1}
+    reg.counter("route.iterations").inc()
+    reg.snapshot(phase="route", iteration=2)
+    reg.snapshot(phase="place", temperature=0)
+    assert reg.series("route.iterations", phase="route") == [3, 4]
+    assert len(reg.snapshots) == 3
+
+
+def test_metrics_disabled_snapshot_noop(tmp_path):
+    reg = MetricsRegistry()                 # enabled=False default
+    reg.counter("c").inc()                  # updates stay cheap + legal
+    assert reg.snapshot(phase="x") is None
+    assert reg.snapshots == []
+    p = tmp_path / "m.json"
+    reg.dump(str(p))
+    doc = json.loads(p.read_text())
+    assert doc["values"]["c"] == 1 and doc["snapshots"] == []
+
+
+def test_metrics_reset_keeps_enabled():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("c").inc()
+    reg.snapshot(phase="x")
+    reg.reset()
+    assert reg.enabled and reg.values() == {} and reg.snapshots == []
+
+
+# ---- JAX compile capture ----
+
+def test_compile_spans_captured():
+    import jax
+    import jax.numpy as jnp
+
+    tr = Tracer()
+    set_tracer(tr)                  # also registers the jax listener
+    # a fresh lambda is a fresh jit cache entry -> a real compile
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    f(jnp.ones((7,))).block_until_ready()
+    assert tr.total("jax.compile") > 0.0
+    names = {e["name"] for e in tr.events if e["cat"] == "jax.compile"}
+    assert any(n.startswith("jax.compile.") for n in names)
+
+
+def test_compile_seconds_accumulator():
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_eda_tpu.obs import compile_seconds, enable_compile_capture
+
+    enable_compile_capture()
+    c0 = compile_seconds()
+    jax.jit(lambda x: x + 3.0)(jnp.ones((5,))).block_until_ready()
+    assert compile_seconds() > c0
+
+
+# ---- tools/trace_report.py ----
+
+def test_trace_report_check_accepts_tracer_output(tmp_path):
+    tr = Tracer()
+    with tr.span("a", x=1):
+        with tr.span("b"):
+            pass
+    p = tmp_path / "ok.json"
+    tr.export(str(p))
+    r = subprocess.run([sys.executable, TRACE_REPORT, str(p), "--check"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    # and the summary mode runs clean on the same file
+    r = subprocess.run([sys.executable, TRACE_REPORT, str(p)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "compile vs execute" in r.stdout
+
+
+def test_trace_report_check_rejects_malformed(tmp_path):
+    tr = _load_trace_report()
+    # field-level problems, detected in-process
+    assert tr.validate([]) != []                          # not an object
+    assert tr.validate({"traceEvents": [
+        {"ph": "X", "name": "a"}]}) != []                 # missing ts/dur
+    assert tr.validate({"traceEvents": [
+        {"ph": "X", "name": "a", "ts": 5, "dur": 1, "pid": 1, "tid": 1},
+        {"ph": "X", "name": "b", "ts": 1, "dur": 1, "pid": 1, "tid": 1},
+    ]}) != []                                             # unsorted
+    assert tr.validate({"traceEvents": [
+        {"ph": "E", "name": "a", "ts": 1, "pid": 1, "tid": 1}]}) != []
+
+    # exit codes through the CLI
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"traceEvents": [{"ph": "X", "name": "a"}]}')
+    r = subprocess.run([sys.executable, TRACE_REPORT, str(bad),
+                       "--check"], capture_output=True, text=True)
+    assert r.returncode == 1 and "MALFORMED" in r.stderr
+    notjson = tmp_path / "not.json"
+    notjson.write_text("{nope")
+    r = subprocess.run([sys.executable, TRACE_REPORT, str(notjson),
+                       "--check"], capture_output=True, text=True)
+    assert r.returncode == 2
+
+
+# ---- CLI surface ----
+
+def test_cli_trace_smoke(tmp_path, capsys):
+    """--trace on the pack-only flow (no place/route: pure host work,
+    fast): a valid Chrome trace with the stage spans lands on disk."""
+    from parallel_eda_tpu.__main__ import main
+
+    p = tmp_path / "t.json"
+    rc = main(["--luts", "12", "--arch", "minimal", "--no_place",
+               "--no_route", "--trace", str(p),
+               "--out_dir", str(tmp_path / "out")])
+    assert rc == 0
+    doc = json.loads(p.read_text())
+    assert _load_trace_report().validate(doc) == []
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"pack", "rr_graph"} <= names
+    assert "trace in" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_cli_trace_full_flow(tmp_path, capsys):
+    """Acceptance shape: a routed flow's trace has pack/place/route
+    stages, per-route-iteration spans, and a nonzero compile split."""
+    from parallel_eda_tpu.__main__ import main
+
+    p = tmp_path / "t.json"
+    sd = tmp_path / "stats"
+    rc = main(["--luts", "30", "--arch", "minimal", "--no_timing",
+               "--trace", str(p), "--stats_dir", str(sd),
+               "--out_dir", str(tmp_path / "out")])
+    assert rc == 0
+    doc = json.loads(p.read_text())
+    assert _load_trace_report().validate(doc) == []
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in evs}
+    assert {"pack", "rr_graph", "place", "route"} <= names
+    iters = [e for e in evs if e["name"] == "route.iter"]
+    assert iters and all("it" in e["args"] for e in iters)
+    assert sum(e["dur"] for e in evs
+               if e["cat"] == "jax.compile") > 0     # compile split
+    # the metrics sink landed next to the mdclog files, with the
+    # per-iteration route snapshots and the shared wire-only overuse
+    m = json.loads((sd / "metrics.json").read_text())
+    route_snaps = [s for s in m["snapshots"]
+                   if s["labels"].get("phase") == "route"]
+    assert route_snaps
+    assert m["values"]["route.success"] is True
+    assert m["values"]["route.overused_wire_nodes"] == 0
+    place_snaps = [s for s in m["snapshots"]
+                   if s["labels"].get("phase") == "place"]
+    assert place_snaps and "place.t" in place_snaps[0]["values"]

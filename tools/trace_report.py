@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""Summarize (or validate) a Chrome trace-event JSON written by
+``python -m parallel_eda_tpu --trace out.json`` (obs.trace.Tracer).
+
+Stdlib-only on purpose — it must run anywhere the trace file lands
+(laptop, CI) without jax or the repo on the path.
+
+    python tools/trace_report.py out.json          # human summary
+    python tools/trace_report.py out.json --check  # validate, exit != 0
+                                                   # on a malformed trace
+
+The summary shows the flow stages (pack / place / route / ...), the
+per-route-iteration trajectory (wall time, overused nodes, pres_fac),
+and the compile-vs-execute split reconstructed from the cat="jax.compile"
+spans the tracer captures off jax.monitoring.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+REQUIRED_X_FIELDS = ("name", "ph", "ts", "pid", "tid")
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def validate(doc) -> list:
+    """Return a list of problems (empty = valid Chrome trace JSON in the
+    shape the tracer emits)."""
+    errs = []
+    if not isinstance(doc, dict):
+        return [f"top level is {type(doc).__name__}, expected object"]
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["missing/non-list 'traceEvents'"]
+    if not evs:
+        errs.append("'traceEvents' is empty")
+    open_begins = {}  # (pid, tid) -> stack depth, for B/E pairing
+    last_ts = None
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            errs.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph is None:
+            errs.append(f"event {i}: missing 'ph'")
+            continue
+        if ph == "M":
+            if "name" not in ev:
+                errs.append(f"event {i}: metadata event without name")
+            continue
+        for field in REQUIRED_X_FIELDS:
+            if field not in ev:
+                errs.append(f"event {i} ({ph}): missing '{field}'")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errs.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            errs.append(f"event {i}: ts {ts} < previous {last_ts} "
+                        f"(events must be sorted)")
+        last_ts = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errs.append(f"event {i}: X event with bad dur {dur!r}")
+        elif ph == "B":
+            key = (ev.get("pid"), ev.get("tid"))
+            open_begins[key] = open_begins.get(key, 0) + 1
+        elif ph == "E":
+            key = (ev.get("pid"), ev.get("tid"))
+            if open_begins.get(key, 0) <= 0:
+                errs.append(f"event {i}: E without matching B on {key}")
+            else:
+                open_begins[key] -= 1
+        elif ph not in ("i", "I", "C"):
+            errs.append(f"event {i}: unsupported phase {ph!r}")
+    for key, depth in open_begins.items():
+        if depth:
+            errs.append(f"{depth} unclosed B event(s) on {key}")
+    return errs
+
+
+def _xs(doc):
+    return [e for e in doc.get("traceEvents", [])
+            if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+def summarize(doc) -> str:
+    evs = _xs(doc)
+    lines = []
+    us = 1e6
+
+    stages = [e for e in evs if e.get("cat") == "stage"]
+    if stages:
+        lines.append("flow stages:")
+        for e in stages:
+            args = e.get("args", {})
+            extra = "".join(f" {k}={v}" for k, v in sorted(args.items()))
+            lines.append(f"  {e['name']:<14} {e['dur'] / us:8.3f}s{extra}")
+
+    iters = [e for e in evs if e.get("name") == "route.iter"]
+    if iters:
+        lines.append(f"route iterations: {len(iters)}")
+        lines.append("  iter    wall_s  overused  pres_fac")
+        for e in iters:
+            a = e.get("args", {})
+            approx = " ~" if a.get("approx") else ""
+            lines.append(f"  {a.get('it', '?'):>4}  {e['dur'] / us:8.3f}"
+                         f"  {a.get('overused', '?'):>8}"
+                         f"  {a.get('pres_fac', '?'):>8}{approx}")
+        if any(e.get("args", {}).get("approx") for e in iters):
+            lines.append("  (~ = iteration inside a fused K>1 device "
+                         "window; wall time evenly attributed)")
+
+    compile_us = sum(e["dur"] for e in evs
+                     if e.get("cat") == "jax.compile")
+    total_us = max((e["ts"] + e["dur"] for e in evs), default=0)
+    lines.append(f"compile vs execute: {compile_us / us:.3f}s jax "
+                 f"compile / {max(0.0, total_us - compile_us) / us:.3f}s "
+                 f"everything else ({total_us / us:.3f}s total)")
+
+    by_cat = {}
+    for e in evs:
+        by_cat.setdefault(e.get("cat", "?"), [0, 0.0])
+        by_cat[e.get("cat", "?")][0] += 1
+        by_cat[e.get("cat", "?")][1] += e["dur"] / us
+    lines.append("span totals by category:")
+    for cat in sorted(by_cat):
+        n, s = by_cat[cat]
+        lines.append(f"  {cat:<12} {n:>5} spans  {s:8.3f}s")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("--check", action="store_true",
+                    help="validate only; exit nonzero if malformed")
+    args = ap.parse_args(argv)
+
+    try:
+        doc = load(args.trace)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"MALFORMED: {e}", file=sys.stderr)
+        return 2
+
+    errs = validate(doc)
+    if args.check:
+        if errs:
+            print("MALFORMED trace:", file=sys.stderr)
+            for e in errs[:20]:
+                print(f"  {e}", file=sys.stderr)
+            return 1
+        print(f"OK: {len(doc['traceEvents'])} events")
+        return 0
+
+    if errs:
+        print(f"warning: {len(errs)} validation problem(s); "
+              f"run with --check for details", file=sys.stderr)
+    print(summarize(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
