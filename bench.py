@@ -23,6 +23,12 @@ import os
 import sys
 import time
 
+# silence the TSL "could not determine host CPU features" WARNING that
+# XLA's CPU client prints on first use: it polluted every captured
+# stderr tail in BENCH_*.json.  Must be set before jax (and through it
+# TSL) initializes; setdefault so an operator's explicit level wins.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
 import numpy as np
 
 
@@ -495,7 +501,7 @@ def main():
     router = Router(rr, RouterOpts(batch_size=args.batch,
                                    program=args.program,
                                    sweep_budget_div=args.budget_div))
-    from parallel_eda_tpu.obs import compile_seconds
+    from parallel_eda_tpu.obs import compile_seconds, get_metrics
     c0 = compile_seconds()
     t0 = time.time()
     res = router.route(term)
@@ -503,6 +509,7 @@ def main():
         f"(success={res.success}, iters={res.iterations})")
     c1 = compile_seconds()
 
+    get_metrics().reset()        # the measured route's ledger only
     t0 = time.time()
     res = router.route(term)
     dt = time.time() - t0
@@ -561,6 +568,12 @@ def main():
                 f", {serial_nets_per_sec:.1f} nets/s, "
                 f"wirelength {sres.wirelength}")
             speedup = nets_per_sec / max(serial_nets_per_sec, 1e-9)
+            if sres.wirelength:
+                # QoR gap of record (device batch-negotiated vs serial
+                # exact incremental): tracked so wirelength regressions
+                # show up in the metrics dump, not just the bench line
+                get_metrics().gauge("route.wirelength_vs_serial").set(
+                    round(res.wirelength / sres.wirelength, 4))
         else:
             serial_nets_per_sec = 0.0
             speedup = 0.0
@@ -576,6 +589,7 @@ def main():
             else 0.0
         speedup = sdt_eff / max(dt, 1e-9)
 
+    mv = get_metrics().values("route.")
     emit(args, {
         "metric": "nets_routed_per_sec",
         "value": round(float(nets_per_sec), 2),
@@ -614,6 +628,21 @@ def main():
                                   else None),
             "vs_native_wall": (round(ndt / max(dt, 1e-9), 5)
                                if native else None),
+            # work-efficiency ledger: per-lever accounting of the
+            # measured route's relaxation sweeps (useful + wasted ==
+            # total by construction) plus the batch-plan shape; the
+            # same numbers land in the metrics dump for
+            # tools/ledger_report.py
+            "ledger": {
+                "relax_steps_useful": int(res.total_relax_steps_useful),
+                "relax_steps_wasted": int(res.total_relax_steps_wasted),
+                "relax_steps_cropped": int(res.total_relax_steps_cropped),
+                "bucket_occupancy": mv.get("route.bucket_occupancy"),
+                "compaction_ratio": mv.get("route.compaction_ratio"),
+                "relax_wasted_frac": mv.get("route.relax_wasted_frac"),
+                "wirelength_vs_serial": mv.get(
+                    "route.wirelength_vs_serial"),
+            },
             # obs rider (obs.metrics / obs.trace): per-iteration
             # overuse trajectory + compile-vs-execute attribution of
             # the measured route (warmup absorbs the cold compiles;

@@ -802,6 +802,40 @@ def _sweep_once(gm: PlanesGeom, s, crit_c, cc_x, cc_y, costs):
     return dx, dy, predx, predy, wx, wy
 
 
+def _run_relax(sweep_fn, state0, nsweeps: int):
+    """Run ``sweep_fn`` to the fixpoint or ``nsweeps`` times, whichever
+    comes first, via a bounded ``lax.while_loop``.
+
+    The sweep is a monotone strict-improvement update (a cell's dist
+    only changes by decreasing, and pred/wenter change iff dist does),
+    so "no dx/dy cell improved" is an exact fixpoint test: once a sweep
+    leaves the distances unchanged, every further sweep is an identity
+    and the early exit is bit-identical to running the remaining trips.
+    The static ``nsweeps`` stays as the trip-count ceiling so the
+    tunneled backend still sees a bounded loop.
+
+    Returns (state, stats) with stats = int32[2] (sweeps executed,
+    sweeps useful).  A sweep is "useful" if it changed some distance;
+    the one extra sweep spent discovering the fixpoint is counted as
+    executed-but-wasted.  When the loop hits the ceiling while still
+    improving, every executed sweep was useful."""
+
+    def cond(carry):
+        i, go, _ = carry
+        return go & (i < nsweeps)
+
+    def body(carry):
+        i, _, s = carry
+        s2 = sweep_fn(s)
+        changed = (jnp.any(s2[0] < s[0]) | jnp.any(s2[1] < s[1]))
+        return i + 1, changed, s2
+
+    i, go, state = lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.bool_(True), state0))
+    useful = jnp.maximum(jnp.int32(0), i - jnp.where(go, 0, 1))
+    return state, jnp.stack([i, useful]).astype(jnp.int32)
+
+
 def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
                  nsweeps: int, mesh=None):
     """Fixed-sweep planes relaxation with predecessor tracking.
@@ -812,11 +846,13 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     [B, 1, 1, 1]; wenter0 [B, Ncells] true delay payload at seeds (entry
     edge delay for SOURCE-side entries, 0 for tree cells).
 
-    The sweep count is STATIC (lax.fori_loop): on the tunneled backend a
-    data-dependent while_loop pays a ~65 ms per-program penalty while
-    fixed-trip loops are free; the Router sizes nsweeps from the batch's
-    bounding boxes (one sweep spans a whole row, so #turns+1 sweeps
-    suffice) and relies on the unreached-sink widening retry as the
+    The sweep count is a STATIC ceiling: the loop is a bounded
+    ``lax.while_loop`` that exits as soon as a sweep improves no
+    distance (see _run_relax — exact, because updates are strict
+    improvements), and ``nsweeps`` — sized by the Router from the
+    batch's bounding boxes (one sweep spans a whole row, so #turns+1
+    sweeps suffice) — caps the trip count so the tunneled backend still
+    sees a bounded loop, with the unreached-sink widening retry as the
     safety net.
 
     With ``mesh`` (a (net, node) jax.sharding.Mesh), the [B, W, X, Y]
@@ -828,7 +864,8 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     exchange (the boundary-node messaging of route.h:330-365, inserted
     by the compiler), y-scans and track rolls stay shard-local.
 
-    Returns (dist_flat, pred_flat, wenter_flat)."""
+    Returns (dist_flat, pred_flat, wenter_flat, stats) with stats =
+    int32[2] (sweeps executed, sweeps useful)."""
     B = d0_flat.shape[0]
     W, NX, NYp1 = pg.shape_x
     _, NXp1, NY = pg.shape_y
@@ -857,20 +894,20 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
 
     costs = _sweep_costs(gm, crit_c, cc_x, cc_y)
 
-    def sweep(_, s):
+    def sweep(s):
         s = _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
         # keep the loop-carried canvases pinned to the mesh layout so
         # GSPMD doesn't migrate them between sweeps
         return tuple(cshard(t) for t in s)
 
-    dx, dy, predx, predy, wx, wy = lax.fori_loop(
-        0, nsweeps, sweep, (dx, dy, predx, predy, wx, wy))
+    (dx, dy, predx, predy, wx, wy), stats = _run_relax(
+        sweep, (dx, dy, predx, predy, wx, wy), nsweeps)
 
     def flat(a, b):
         return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
                                axis=1)
 
-    return flat(dx, dy), flat(predx, predy), flat(wx, wy)
+    return flat(dx, dy), flat(predx, predy), flat(wx, wy), stats
 
 
 def crop_state(pg: PlanesGraph, d0_flat, cc_flat, wenter0, ox, oy,
@@ -940,7 +977,7 @@ def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
     the tile return their d0 / self-pred / wenter0 unchanged (they are
     unreachable in the full program too: their cc is INF).
 
-    Same (dist, pred, wenter) flat returns as planes_relax."""
+    Same (dist, pred, wenter, stats) returns as planes_relax."""
     gm_full = geom_full(pg)
     gm = geom_cropped(pg, ox, oy, cnx, cny, full=gm_full)
     fulls, (dx, dy, cc_x, cc_y, wx, wy) = crop_state(
@@ -950,14 +987,14 @@ def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
 
     costs = _sweep_costs(gm, crit_c, cc_x, cc_y)
 
-    def sweep(_, s):
+    def sweep(s):
         return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
-    tiles = lax.fori_loop(0, nsweeps, sweep,
-                          (dx, dy, predx, predy, wx, wy))
+    tiles, stats = _run_relax(sweep, (dx, dy, predx, predy, wx, wy),
+                              nsweeps)
     # scatter the tiles back into the full canvases (one full-canvas
     # write per relaxation instead of ~15 traversals per sweep)
-    return scatter_state(gm_full, fulls, tiles, ox, oy)
+    return scatter_state(gm_full, fulls, tiles, ox, oy) + (stats,)
 
 
 # ---------------------------------------------------------------------------
@@ -1089,9 +1126,9 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         crop_oy = jnp.clip(bb_anchor[:, 2] - Lm, 0, NYg - cny_t
                            ).astype(jnp.int32)
 
-    def wave_body(wave, state):
+    def wave_run(wave, state):
         (seed_cells, tdel_cells, opin_used, remaining, wpaths, delay,
-         reached_all) = state
+         reached_all, st) = state
         crit_w = jnp.max(jnp.where(remaining, b_crit, 0.0), axis=1)  # [B]
         cw = 1.0 - crit_w
         cc_flat = cw[:, None] * cc_flat_base
@@ -1105,7 +1142,12 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
             [cc_flat, jnp.full((B, 1), INF)], axis=1)
         e_cc = jnp.take_along_axis(cc_flat_p1,
                                    jnp.minimum(b_ecell, ncells), axis=1)
-        e_cost = e_du + crit_w[:, None] * b_edelay + e_cc
+        # invalid/clean slots get all-INF entry seeds: their canvases
+        # then never improve, so they neither extend the batch's
+        # convergence loop nor do any discoverable work (their results
+        # were always discarded at the sel_v scatter below)
+        e_cost = jnp.where(valid[:, None],
+                           e_du + crit_w[:, None] * b_edelay + e_cc, INF)
         d0 = d_seed.at[arangeB[:, None], b_ecell].min(e_cost, mode="drop")
         entry_flag = d0 < d_seed                               # [B, Ncells]
         # winning entry index per cell (ties -> lowest k, deterministic)
@@ -1127,20 +1169,22 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
         if use_pallas:
             if crop_tile is not None:
                 from .planes_pallas import planes_relax_cropped_pallas
-                dist, pred, wenter = planes_relax_cropped_pallas(
+                dist, pred, wenter, rst = planes_relax_cropped_pallas(
                     pg, d0, cc_flat, crit_c, wenter0, nsweeps,
                     crop_ox, crop_oy, cnx_t, cny_t)
             else:
                 from .planes_pallas import planes_relax_pallas
-                dist, pred, wenter = planes_relax_pallas(
+                dist, pred, wenter, rst = planes_relax_pallas(
                     pg, d0, cc_flat, crit_c, wenter0, nsweeps)
         elif crop_tile is not None:
-            dist, pred, wenter = planes_relax_cropped(
+            dist, pred, wenter, rst = planes_relax_cropped(
                 pg, d0, cc_flat, crit_c, wenter0, nsweeps,
                 crop_ox, crop_oy, cnx_t, cny_t)
         else:
-            dist, pred, wenter = planes_relax(pg, d0, cc_flat, crit_c,
-                                              wenter0, nsweeps, mesh)
+            dist, pred, wenter, rst = planes_relax(pg, d0, cc_flat,
+                                                   crit_c, wenter0,
+                                                   nsweeps, mesh)
+        st = st + rst
 
         # --- sink extraction from the per-net candidate tables ---
         dist_p1 = jnp.concatenate([dist, jnp.full((B, 1), INF)], axis=1)
@@ -1309,14 +1353,24 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                                  jnp.where(pdm, pick_doidx, O)].set(
             True, mode="drop") | opin_used
         return (seed_cells, tdel_cells, opin_used, remaining, wpaths,
-                delay, reached_all)
+                delay, reached_all, st)
+
+    def wave_body(wave, state):
+        # once every (valid) sink is reached the remaining waves are
+        # identity passes — skip their relaxations entirely (exact: a
+        # wave with no remaining sinks picks nothing and commits
+        # nothing, verified against the unconditional body)
+        return lax.cond(state[3].any(),
+                        lambda s: wave_run(wave, s), lambda s: s, state)
 
     state0 = (seed0, jnp.zeros((B, ncells), jnp.float32),
-              jnp.zeros((B, O), bool), b_sinks >= 0,
+              jnp.zeros((B, O), bool),
+              (b_sinks >= 0) & valid[:, None],
               jnp.full((B, S, max_len), N, jnp.int32),
               jnp.full((B, S), INF, jnp.float32),
-              jnp.zeros((B, S), bool))
-    (_, _, _, _, p, delay, reached) = lax.fori_loop(
+              jnp.zeros((B, S), bool),
+              jnp.zeros((2,), jnp.int32))
+    (_, _, _, _, p, delay, reached, st) = lax.fori_loop(
         0, num_waves, wave_body, state0)
 
     usage = usage_from_paths(p, nodes_p1) & valid[:, None]
@@ -1343,7 +1397,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
     all_reached = all_reached.at[sel_v].set(ok, mode="drop")
     bb = bb.at[sel_v].set(new_bb, mode="drop")
     return (paths, sink_delay, all_reached, bb, occ_new,
-            valid.sum(dtype=jnp.int32))
+            valid.sum(dtype=jnp.int32), st[0], st[1])
 
 
 @functools.partial(
@@ -1371,7 +1425,7 @@ def route_batch_resident_planes(
         # its own terminals (silently unroutable)
         raise ValueError("crop_tile requires bb0_all (static initial "
                          "bbs) as the crop anchor")
-    paths, sink_delay, all_reached, bb, occ, _ = _step_core(
+    paths, sink_delay, all_reached, bb, occ, _, st_exec, _ = _step_core(
         pg, dev, occ, acc, pres_fac, paths, sink_delay, all_reached, bb,
         source_all, sinks_all, crit_all,
         opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
@@ -1380,8 +1434,7 @@ def route_batch_resident_planes(
         sel, valid, jnp.bool_(True), full_bb,
         nsweeps, max_len, num_waves, group, doubling, mesh, use_pallas,
         crop_tile, bb0_all)
-    return (paths, sink_delay, all_reached, bb, occ,
-            jnp.int32(nsweeps * num_waves))
+    return (paths, sink_delay, all_reached, bb, occ, st_exec)
 
 
 def _mis_colors(dev: DeviceRRGraph, occ, paths, all_reached,
@@ -1472,20 +1525,24 @@ def route_window_planes(
 
     Returns (occ, acc, paths, sink_delay, all_reached, bb, pres,
     rrm [R], colors [R], n_over, over_total, nroutes, nexec, crit_all,
-    dmax_hist)."""
+    dmax_hist, ..., steps_exec, steps_useful) — the last two are the
+    MEASURED relaxation-sweep counters summed over every executed
+    group/wave of the window (executed trips of the bounded while_loop,
+    and the subset that improved some distance)."""
     G = sel_plan.shape[0]
     R, Smax = sinks_all.shape
 
     def it_body(it, st):
         (occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes,
-         nexec, crit_all, dmax_hist) = st
+         nexec, crit_all, dmax_hist, s_exec, s_useful) = st
         force = (it0 + it) < force_until
 
         def g_step(g, st2):
             def run(st3):
-                occ2, paths2, sink_delay2, all_reached2, bb2, nr, ng = st3
+                (occ2, paths2, sink_delay2, all_reached2, bb2, nr, ng,
+                 se, su) = st3
                 (paths2, sink_delay2, all_reached2, bb2, occ2,
-                 n_act) = _step_core(
+                 n_act, st_exec, st_useful) = _step_core(
                     pg, dev, occ2, acc, pres,
                     paths2, sink_delay2, all_reached2, bb2,
                     source_all, sinks_all, crit_all,
@@ -1497,7 +1554,7 @@ def route_window_planes(
                     nsweeps, max_len, num_waves, group, doubling, mesh,
                     use_pallas, crop_tile, bb0_all, widen_ok)
                 return (occ2, paths2, sink_delay2, all_reached2, bb2,
-                        nr + n_act, ng + 1)
+                        nr + n_act, ng + 1, se + st_exec, su + st_useful)
 
             # skip pow2-padding groups and fully-clean groups outright
             # (the group plan is padded to a power of two to bound the
@@ -1512,9 +1569,10 @@ def route_window_planes(
             return lax.cond(any_dirty, run, lambda s: s, st2)
 
         (occ, paths, sink_delay, all_reached, bb, nroutes,
-         nexec) = lax.fori_loop(
+         nexec, s_exec, s_useful) = lax.fori_loop(
             0, G, g_step,
-            (occ, paths, sink_delay, all_reached, bb, nroutes, nexec))
+            (occ, paths, sink_delay, all_reached, bb, nroutes, nexec,
+             s_exec, s_useful))
         # PathFinder history/present escalation once per iteration
         acc = acc + acc_fac * jnp.maximum(
             occ - dev.capacity, 0).astype(jnp.float32)
@@ -1530,14 +1588,15 @@ def route_window_planes(
             crit_all = crit_flat.reshape(R, Smax)
             dmax_hist = dmax_hist.at[it].set(dmax)
         return (occ, acc, paths, sink_delay, all_reached, bb, pres,
-                nroutes, nexec, crit_all, dmax_hist)
+                nroutes, nexec, crit_all, dmax_hist, s_exec, s_useful)
 
     (occ, acc, paths, sink_delay, all_reached, bb, pres, nroutes,
-     nexec, crit_all, dmax_hist) = lax.fori_loop(
+     nexec, crit_all, dmax_hist, s_exec, s_useful) = lax.fori_loop(
         0, K_iters, it_body,
         (occ, acc, paths, sink_delay, all_reached, bb, pres0,
          jnp.int32(0), jnp.int32(0), crit_all,
-         jnp.full(K_iters, jnp.nan, jnp.float32)))
+         jnp.full(K_iters, jnp.nan, jnp.float32),
+         jnp.int32(0), jnp.int32(0)))
 
     rrm, colors = _mis_colors(dev, occ, paths, all_reached,
                               topk, n_colors)
@@ -1569,4 +1628,5 @@ def route_window_planes(
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
             colors, (over > 0).sum(dtype=jnp.int32),
             over.sum(dtype=jnp.int32), nroutes, nexec, crit_all,
-            dmax_hist, max_span, dev_wide, live_wh, unreached)
+            dmax_hist, max_span, dev_wide, live_wh, unreached,
+            s_exec, s_useful)

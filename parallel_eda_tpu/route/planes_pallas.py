@@ -28,8 +28,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from .planes import (PlanesGeom, PlanesGraph, _sweep_costs, _sweep_once,
-                     crop_state, geom_cropped, geom_full, scatter_state)
+from .planes import (PlanesGeom, PlanesGraph, _run_relax, _sweep_costs,
+                     _sweep_once, crop_state, geom_cropped, geom_full,
+                     scatter_state)
 
 
 def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
@@ -41,7 +42,8 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
                   fx_ref, lx_ref, fy_ref, ly_ref,
                   delx_ref, dely_ref, delr0_ref, delr1_ref, inc_ref,
                   # outputs
-                  odx_ref, ody_ref, opx_ref, opy_ref, owx_ref, owy_ref):
+                  odx_ref, ody_ref, opx_ref, opy_ref, owx_ref, owy_ref,
+                  ost_ref):
     """One grid step = one net: load canvases into VMEM values, rebuild
     a PlanesGeom view over the loaded masks, run the shared sweep body
     nsweeps times, store results."""
@@ -81,11 +83,13 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
 
     costs = _sweep_costs(gm, crit_c, cc_x, cc_y)
 
-    def body(_, s):
+    def body(s):
         return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
-    dx, dy, predx, predy, wx, wy = jax.lax.fori_loop(
-        0, nsweeps, body, (dx, dy, predx, predy, wx, wy))
+    # per-net bounded while_loop: this net stops sweeping at ITS OWN
+    # fixpoint (the XLA batched program can only stop at the batch's)
+    (dx, dy, predx, predy, wx, wy), stats = _run_relax(
+        body, (dx, dy, predx, predy, wx, wy), nsweeps)
 
     odx_ref[:] = dx
     ody_ref[:] = dy
@@ -93,6 +97,7 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
     opy_ref[:] = predy
     owx_ref[:] = wx
     owy_ref[:] = wy
+    ost_ref[:] = stats.reshape(1, 2)
 
 
 @functools.partial(jax.jit, static_argnames=("nsweeps", "interpret"))
@@ -143,12 +148,13 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
                   jax.ShapeDtypeStruct((B,) + shx, jnp.int32),
                   jax.ShapeDtypeStruct((B,) + shy, jnp.int32),
                   jax.ShapeDtypeStruct((B,) + shx, f32),
-                  jax.ShapeDtypeStruct((B,) + shy, f32)]
+                  jax.ShapeDtypeStruct((B,) + shy, f32),
+                  jax.ShapeDtypeStruct((B, 2), jnp.int32)]
     out_specs = [bspec(shx), bspec(shy), bspec(shx), bspec(shy),
-                 bspec(shx), bspec(shy)]
+                 bspec(shx), bspec(shy), bspec((2,))]
 
     kern = functools.partial(_sweep_kernel, pg, nsweeps)
-    dx, dy, px, py, wx, wy = pl.pallas_call(
+    dx, dy, px, py, wx, wy, stats = pl.pallas_call(
         kern,
         grid=(B,),
         in_specs=[bspec(shx), bspec(shy), bspec(shx), bspec(shy),
@@ -163,7 +169,10 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
         return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
                                axis=1)
 
-    return flat(dx, dy), flat(px, py), flat(wx, wy)
+    # batch-level stats: the slowest net's trip count — what the
+    # equivalent batched while_loop would have executed
+    bstats = jnp.stack([stats[:, 0].max(), stats[:, 1].max()])
+    return flat(dx, dy), flat(px, py), flat(wx, wy), bstats
 
 
 def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
@@ -177,7 +186,7 @@ def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
                        idxx_ref, idxy_ref, par_ref, inc_ref,
                        # outputs
                        odx_ref, ody_ref, opx_ref, opy_ref, owx_ref,
-                       owy_ref):
+                       owy_ref, ost_ref):
     """One grid step = one net's bb TILE, whole nsweeps loop in VMEM.
     Geometry arrives pre-cropped (geom_cropped computes the per-net
     slices in XLA), so every block here is tile-shaped and the kernel
@@ -206,17 +215,18 @@ def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
 
     costs = _sweep_costs(gm, crit_c, cc_x, cc_y)
 
-    def body(_, s):
+    def body(s):
         return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
-    dx, dy, predx, predy, wx, wy = jax.lax.fori_loop(
-        0, nsweeps, body, (dx, dy, predx, predy, wx, wy))
+    (dx, dy, predx, predy, wx, wy), stats = _run_relax(
+        body, (dx, dy, predx, predy, wx, wy), nsweeps)
     odx_ref[:] = dx
     ody_ref[:] = dy
     opx_ref[:] = predx
     opy_ref[:] = predy
     owx_ref[:] = wx
     owy_ref[:] = wy
+    ost_ref[:] = stats.reshape(1, 2)
 
 
 @functools.partial(jax.jit,
@@ -270,13 +280,14 @@ def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
                   jax.ShapeDtypeStruct((B,) + shx, jnp.int32),
                   jax.ShapeDtypeStruct((B,) + shy, jnp.int32),
                   jax.ShapeDtypeStruct((B,) + shx, f32),
-                  jax.ShapeDtypeStruct((B,) + shy, f32)]
+                  jax.ShapeDtypeStruct((B,) + shy, f32),
+                  jax.ShapeDtypeStruct((B, 2), jnp.int32)]
     out_specs = [bspec(shx), bspec(shy), bspec(shx), bspec(shy),
-                 bspec(shx), bspec(shy)]
+                 bspec(shx), bspec(shy), bspec((2,))]
 
     kern = functools.partial(_crop_sweep_kernel, pg.directional,
                              NYp1, nsweeps)
-    dx, dy, px, py, wx, wy = pl.pallas_call(
+    dx, dy, px, py, wx, wy, stats = pl.pallas_call(
         kern,
         grid=(B,),
         in_specs=[bspec(shx), bspec(shy), bspec(shx), bspec(shy),
@@ -287,5 +298,6 @@ def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
         interpret=interpret,
     )(dx0, dy0, ccx, ccy, critb, wx0, wy0, *geo, inc)
 
+    bstats = jnp.stack([stats[:, 0].max(), stats[:, 1].max()])
     return scatter_state(gm_full, fulls, (dx, dy, px, py, wx, wy),
-                         ox, oy)
+                         ox, oy) + (bstats,)

@@ -205,6 +205,11 @@ class RouteResult:
     # search effort counters (perf_t analogue, route.h:12-20)
     total_net_routes: int = 0
     total_relax_steps: int = 0
+    # work-efficiency ledger: of the executed sweeps, how many improved
+    # some distance (useful) vs ran as fixpoint-discovery / ceiling
+    # overhead (wasted).  useful + wasted == total_relax_steps.
+    total_relax_steps_useful: int = 0
+    total_relax_steps_wasted: int = 0
     # of which: sweeps over bb-CROPPED canvases (tile area, not grid
     # area — the two cost very different device time; bench projections
     # need the split)
@@ -262,6 +267,10 @@ def write_stats_files(stats_dir: str, result: "RouteResult") -> None:
         f.write(f"total_route_time "
                 f"{sum(s.route_time_s for s in result.stats):.6f}\n")
         f.write(f"total_relax_steps {result.total_relax_steps}\n")
+        f.write(f"total_relax_steps_useful "
+                f"{result.total_relax_steps_useful}\n")
+        f.write(f"total_relax_steps_wasted "
+                f"{result.total_relax_steps_wasted}\n")
         f.write(f"total_net_routes {result.total_net_routes}\n")
         f.write(f"wirelength {result.wirelength}\n")
         # the converged iteration breaks out before its timing callback,
@@ -351,6 +360,53 @@ def _pad_to(a: np.ndarray, B: int, fill) -> np.ndarray:
 
 def _pow2_at_least(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
+
+
+def _size_class_buckets(need_w: np.ndarray, need_h: np.ndarray,
+                        nx: int, ny: int, min_count: int = 1,
+                        base: int = 8, full_frac: float = 0.8):
+    """Bin nets into pow-2 size-class crop buckets.
+
+    ``need_w``/``need_h`` are the per-net canvas requirements (live bb
+    span + crop margin, in grid cells).  The ladder is base, 2*base,
+    4*base, ... clamped to the grid; it stops at the first rung whose
+    tile covers the grid or whose area reaches ``full_frac`` of the
+    grid area (a crop that big saves nothing over the full canvas, and
+    the full-canvas program is the one the mesh path shards).  Each net
+    gets the SMALLEST rung that fits both of its spans; nets that fit
+    no rung take the full canvas.  Rungs holding fewer than
+    ``min_count`` nets are merged upward (a near-empty bucket costs a
+    whole program launch for a handful of nets).
+
+    Returns (classes, assign): ``classes`` is a list of (cw, ch) crop
+    tiles, ascending; ``assign[i] == len(classes)`` means net i routes
+    on the full canvas.  Deterministic — pure function of the spans and
+    the grid."""
+    n = len(need_w)
+    ladder = []
+    s = base
+    while True:
+        cw, ch = min(nx, s), min(ny, s)
+        if cw * ch >= full_frac * nx * ny or (cw == nx and ch == ny):
+            break
+        ladder.append((cw, ch))
+        s *= 2
+    assign = np.full(n, len(ladder), dtype=np.int64)
+    for k in range(len(ladder) - 1, -1, -1):
+        cw, ch = ladder[k]
+        assign[(need_w <= cw) & (need_h <= ch)] = k
+    # merge under-populated rungs upward (into the next rung, or the
+    # full-canvas class off the top of the ladder)
+    for k in range(len(ladder)):
+        cnt = int((assign == k).sum())
+        if 0 < cnt < min_count:
+            assign[assign == k] = k + 1
+    # compact the populated rungs to dense ids, full class last
+    used = [k for k in range(len(ladder)) if (assign == k).any()]
+    lut = np.full(len(ladder) + 1, len(used), dtype=np.int64)
+    for j, k in enumerate(used):
+        lut[k] = j
+    return [ladder[k] for k in used], lut[assign]
 
 
 def path_budget(span: int, cap: int) -> int:
@@ -445,22 +501,32 @@ class Router:
     @staticmethod
     def _obs_window(tw0: float, it_done: int, K: int, n_over: int,
                     over_total: int, rerouted: int, relax_steps: int,
-                    pres: float, cpd: float, batches: int) -> None:
+                    pres: float, cpd: float, batches: int,
+                    relax_useful: Optional[int] = None,
+                    bucket_occ=(), compaction: float = 1.0) -> None:
         """Trace + metrics for one committed window: a route.window
         span, K route.iter child spans, and the per-iteration registry
         snapshot.  Iteration boundaries inside a K>1 fused window are
         not host-visible, so the window's wall time is attributed
         evenly across its iterations and the spans carry approx=True —
         the stats_dir / host-callback paths force K=1 and get exact
-        per-iteration spans."""
+        per-iteration spans.
+
+        ``relax_useful`` / ``bucket_occ`` / ``compaction`` feed the
+        work-efficiency ledger: sweeps that improved a distance vs.
+        total executed, per-dispatch batch-slot occupancy, and the
+        compacted/full plan-width ratio."""
         tw1 = time.perf_counter()
+        useful = relax_steps if relax_useful is None else relax_useful
         tr = get_tracer()
         if tr is not None:
             tr.add_complete(
                 "route.window", tw0, tw1 - tw0, cat="route",
                 first_iter=it_done - K + 1, last_iter=it_done, K=K,
                 rerouted=rerouted, overused_nodes=n_over,
-                relax_steps=relax_steps)
+                relax_steps=relax_steps,
+                relax_steps_useful=int(useful),
+                relax_steps_wasted=int(relax_steps - useful))
             dt = (tw1 - tw0) / max(1, K)
             for j in range(K):
                 tr.add_complete("route.iter", tw0 + j * dt, dt,
@@ -471,6 +537,14 @@ class Router:
         reg = get_metrics()
         reg.counter("route.iterations").inc(K)
         reg.counter("route.relax_steps").inc(relax_steps)
+        reg.counter("route.relax_steps_useful").inc(int(useful))
+        reg.counter("route.relax_steps_wasted").inc(
+            int(relax_steps - useful))
+        for occ_frac in bucket_occ:
+            reg.histogram("route.bucket_occupancy").record(
+                float(occ_frac))
+        reg.gauge("route.compaction_ratio").set(round(float(compaction),
+                                                      6))
         reg.counter("route.batches").inc(batches)
         reg.gauge("route.overused_nodes").set(int(n_over))
         reg.gauge("route.overuse_total").set(int(over_total))
@@ -493,6 +567,12 @@ class Router:
         reg.gauge("route.wirelength").set(int(result.wirelength))
         reg.gauge("route.widened_nets").set(int(result.widened_nets))
         reg.gauge("route.net_routes").set(int(result.total_net_routes))
+        # end-of-route work-efficiency ledger (per-window counters
+        # accumulate in route.relax_steps_{useful,wasted}): the wasted
+        # fraction is THE lever-attribution number for bench runs
+        total = max(1, result.total_relax_steps)
+        reg.gauge("route.relax_wasted_frac").set(
+            round(result.total_relax_steps_wasted / total, 6))
         reg.gauge("route.overused_wire_nodes").set(
             overused_wire_nodes(self.rr, result.occ))
         reg.snapshot(phase="route_final", iteration=result.iterations)
@@ -538,11 +618,24 @@ class Router:
             batches.extend(_order_and_chunk(g, nsinks, cx, cy, B))
         if not batches:
             batches = [np.zeros(0, dtype=np.int64)]
+        # converged-net compaction: once most nets are clean the per-
+        # color chunks are far shorter than B — narrow the PLAN WIDTH to
+        # the largest chunk (pow2-bucketed, floor 8, so the compiled
+        # window-program variants stay O(log B)) instead of shipping
+        # B-wide plans that are mostly masked-off padding.  Chunking
+        # stays at B, so batch membership — and the negotiation — is
+        # unchanged; only the dead slots are dropped.  Under a mesh the
+        # width must stay B (the batch axis is sharded over "net", whose
+        # size need not divide a narrower pow2).
+        B_g = B
+        if self.mesh is None:
+            B_g = min(B, max(8, _pow2_at_least(
+                max(len(b) for b in batches))))
         # pad the group count to a power of two: G is a traced shape, so
         # padding keeps the set of compiled window programs small
         G = _pow2_at_least(len(batches))
-        sel_plan = np.zeros((G, B), dtype=np.int32)
-        valid_plan = np.zeros((G, B), dtype=bool)
+        sel_plan = np.zeros((G, B_g), dtype=np.int32)
+        valid_plan = np.zeros((G, B_g), dtype=bool)
         for i, b in enumerate(batches):
             sel_plan[i, :len(b)] = b
             valid_plan[i, :len(b)] = True
@@ -617,11 +710,6 @@ class Router:
         fin_save = None
         force_all_next = False
         widx = 0
-        # monotonic crop-tile ratchet: tiles only GROW within one route
-        # call (and stick at full once any window needs it) so the
-        # number of compiled window-program variants stays O(1) — on
-        # the tunneled TPU every new static shape is a remote compile
-        crop_cw = crop_ch = 0
         # crop composes with the Pallas program (tile-blocked VMEM
         # kernel, planes_relax_cropped_pallas); only the spatially
         # sharded mesh path keeps full canvases (crops are net-local)
@@ -660,8 +748,6 @@ class Router:
             finish_done = d.get("finish_done", False)
             force_all_next = d["force_all_next"]
             result.widened_nets = d["widened_nets"]
-            crop_cw = d.get("crop_cw", 0)
-            crop_ch = d.get("crop_ch", 0)
             crop_full = d.get("crop_full", crop_full)
 
         L = int(paths.shape[2])          # current path-slot budget
@@ -715,63 +801,45 @@ class Router:
                 term.bb_ymax[dirty] - term.bb_ymin[dirty] + 1,
                 live_h[dirty])) if len(dirty) else np.array([8])
 
-            # bb-crop tile bucket (static per compile): smallest
-            # 8-bucket covering >=90% of the dirty nets + the wire-
-            # overhang margin; nets past it (device-spanning resets,
-            # host-widened boxes) run in a SEPARATE full-canvas window
-            # call — the planes analogue of the ELL path's narrow/wide
-            # group split.  Tiles only grow within one route call (the
-            # compile-variant ratchet); the unsharded XLA AND Pallas
-            # programs both crop, only the spatial mesh path keeps
-            # full canvases (crops are net-local)
-            crop_tile = None
-            narrow = np.ones(len(dirty), dtype=bool)
+            # size-class crop bucketing (static tiles per compile): bin
+            # the window's work set by bb span into pow-2 crop classes
+            # (ladder 8, 16, 32, ... clamped at the grid) and dispatch
+            # ONE cropped window call per populated class — a 4x4-span
+            # net no longer sweeps the worst net's canvas — plus one
+            # full-canvas call for whatever fits no rung (device-
+            # spanning resets, host-widened boxes): the planes analogue
+            # of the ELL path's narrow/wide split, generalized to a
+            # ladder.  The ladder is a fixed function of the grid, so
+            # the compiled window-program variants stay O(log grid);
+            # the unsharded XLA AND Pallas programs both crop, only the
+            # spatial mesh path keeps full canvases (crops are
+            # net-local).  dispatch = [(subset, tile or None), ...],
+            # smallest tiles first, full canvas last.
             if crop_forced is not None and len(dirty):
                 Lm = self.pg.max_span
-                crop_tile = crop_forced
                 narrow = ((w_all + 2 * Lm <= crop_forced[0])
                           & (h_all + 2 * Lm <= crop_forced[1]))
-                if not narrow.any():
-                    crop_tile = None
-                    narrow[:] = True
+                dispatch = []
+                if narrow.any():
+                    dispatch.append((dirty[narrow], crop_forced))
+                if not narrow.all():
+                    dispatch.append((dirty[~narrow], None))
             elif not crop_full and len(dirty):
                 Lm = self.pg.max_span
-                NXg, NYg = rr.grid.nx, rr.grid.ny
-                nD = len(dirty)
-                sw, sh = np.sort(w_all), np.sort(h_all)
-                # per-sweep work proxy: canvas area x sweeps (sweeps
-                # scale with the span); pick the percentile split whose
-                # narrow-cropped + wide-full cost is cheapest, crop only
-                # when it beats all-full by >=20%
-                full_cost = (-(-nD // B)) * NXg * NYg * (NXg + NYg)
-                best_cost = full_cost
-                best = None
-                for pct in (0.5, 0.75, 0.9, 1.0):
-                    q = max(1, int(np.ceil(pct * nD))) - 1
-                    cw = max(crop_cw, min(
-                        NXg, -(-(int(sw[q]) + 2 * Lm) // 8) * 8))
-                    ch = max(crop_ch, min(
-                        NYg, -(-(int(sh[q]) + 2 * Lm) // 8) * 8))
-                    if cw * ch >= NXg * NYg:
-                        continue
-                    nm = ((w_all + 2 * Lm <= cw)
-                          & (h_all + 2 * Lm <= ch))
-                    g_n = -(-int(nm.sum()) // B)
-                    g_w = -(-int(nD - nm.sum()) // B)
-                    cost = (g_n * cw * ch * (cw + ch)
-                            + g_w * NXg * NYg * (NXg + NYg))
-                    if cost < best_cost:
-                        best_cost, best = cost, (cw, ch, nm)
-                if best is not None and best_cost <= 0.8 * full_cost:
-                    crop_cw, crop_ch, narrow = best
-                    crop_tile = (crop_cw, crop_ch)
-                else:
-                    # tiles this close to the grid never pay; stop
-                    # re-evaluating (and recompiling) for this route
-                    crop_full = crop_cw * crop_ch >= NXg * NYg
+                classes, assign = _size_class_buckets(
+                    w_all + 2 * Lm, h_all + 2 * Lm,
+                    rr.grid.nx, rr.grid.ny,
+                    min_count=max(1, B // 8))
+                dispatch = [(dirty[assign == k], tile)
+                            for k, tile in enumerate(classes)]
+                if (assign == len(classes)).any():
+                    dispatch.append((dirty[assign == len(classes)],
+                                     None))
+            else:
+                dispatch = [(dirty, None)]
             if _DEBUG_CROP:
-                print("DBGCROP", "tile", crop_tile, "narrow",
-                      int(narrow.sum()), "/", len(dirty),
+                print("DBGCROP", "dispatch",
+                      [(len(s), t) for s, t in dispatch],
                       "crop_full", crop_full, flush=True)
 
             widen_d = (None if opts.sweep_budget_div <= 1
@@ -853,17 +921,21 @@ class Router:
                     doubling, min(4096, N), 5, self.mesh,
                     use_pallas=self.use_pallas, crop_tile=tile,
                     bb0_all=bb0_d, widen_ok=wok, **sta_kw)
-                return out, waves * nsw
+                # plan-shape ledger inputs: filled batch slots, plan
+                # width, and real (non-pad) batch rows of this dispatch
+                return out, (int(valid_p.sum()), valid_p.shape[1],
+                             int(valid_p.any(axis=1).sum()))
 
             t0 = time.time()
             tw0 = time.perf_counter()
             w_steps = 0
+            w_useful = 0
             w_steps_crop = 0
             nroutes_w = 0
             nexec_w = 0
-            # dispatch plan: narrow/cropped nets first (with
-            # escalation), wide remainder on full canvases.  (A
-            # further split by fanout class — per-call num_waves
+            # dispatch order: cropped size classes ascending (the first
+            # carries the acc escalation), full-canvas remainder last.
+            # (A further split by fanout class — per-call num_waves
             # adapts to the subset max — was measured at 600 LUTs and
             # REJECTED: reordering hi-fan nets behind the lo-fan
             # commits diverged the negotiation, 30 iters vs 16 and 2x
@@ -872,37 +944,44 @@ class Router:
             # last are fetched only AFTER the last call is dispatched,
             # so the extra host work overlaps the device instead of
             # serializing extra syncs
-            dispatch = ([(dirty[narrow], crop_tile),
-                         (dirty[~narrow], None)]
-                        if crop_tile is not None and not narrow.all()
-                        else [(dirty, crop_tile)])
             outs = []
             esc = True
+            bucket_occ = []
+            comp_num = comp_den = 0
             for sub0, tile in dispatch:
-                o, pg_c = window_call(sub0, tile, esc, pres)
+                o, (nvalid, bg, grows) = window_call(sub0, tile, esc,
+                                                     pres)
                 esc = False
                 occ, acc, paths, sink_delay, all_reached, bb = o[:6]
                 crit_d = o[13]
-                outs.append((o, pg_c, tile))
-            out, per_g, last_tile = outs[-1]
-            for o, pg_c, tile_c in outs[:-1]:
-                n1, e1 = (int(np.asarray(v)) for v in jax.device_get(
-                    (o[11], o[12])))
+                outs.append((o, tile))
+                if grows:
+                    bucket_occ.append(nvalid / (grows * bg))
+                    comp_num += grows * bg
+                    comp_den += grows * B
+            out, last_tile = outs[-1]
+            for o, tile_c in outs[:-1]:
+                n1, e1, se1, su1 = (
+                    int(np.asarray(v)) for v in jax.device_get(
+                        (o[11], o[12], o[19], o[20])))
                 nroutes_w += n1
                 nexec_w += e1
-                w_steps += e1 * pg_c
+                w_steps += se1
+                w_useful += su1
                 if tile_c is not None:
-                    w_steps_crop += e1 * pg_c
+                    w_steps_crop += se1
             force_all_next = False
             # the ONE sync per window (dmax_hist rides along: the
             # per-iteration crit-path delays from the fused STA;
-            # max_span: largest dirty-net bb for path-budget regrowth)
+            # max_span: largest dirty-net bb for path-budget regrowth;
+            # s_exec/s_useful: the measured relax-sweep ledger)
             (rrm, colors, n_over, over_total, nroutes, nexec, dmax_hist,
-             max_span, dev_wide, live_wh, unreached) = (
+             max_span, dev_wide, live_wh, unreached, s_exec,
+             s_useful) = (
                 np.asarray(v) for v in jax.device_get(
                     (out[7], out[8], out[9], out[10], out[11],
                      out[12], out[14], out[15], out[16], out[17],
-                     out[18])))
+                     out[18], out[19], out[20])))
             # unpack measured live bb sizes (8-tile buckets, see
             # planes.py summary); feeds the next window's partition
             live_w = ((live_wh.astype(np.int64) >> 8) & 0xFF) * 8
@@ -921,14 +1000,19 @@ class Router:
             n_over, over_total = int(n_over), int(over_total)
             it_done += K
             # nexec = groups that actually executed on device (pad and
-            # clean groups skip), so the step counter reflects real work
+            # clean groups skip); w_steps/w_useful are the MEASURED
+            # sweep counters from the bounded while_loops, so the step
+            # ledger reflects real work, not the dispatch budget
             nroutes = nroutes_w + int(nroutes)
             nexec = nexec_w + int(nexec)
-            w_steps += int(nexec - nexec_w) * per_g
+            w_steps += int(s_exec)
+            w_useful += int(s_useful)
             if last_tile is not None:
-                w_steps_crop += int(nexec - nexec_w) * per_g
+                w_steps_crop += int(s_exec)
             result.total_net_routes += int(nroutes)
             result.total_relax_steps += w_steps
+            result.total_relax_steps_useful += w_useful
+            result.total_relax_steps_wasted += w_steps - w_useful
             result.total_relax_steps_cropped += w_steps_crop
             cpd = float(dmax_hist[K - 1]) if analyzer is not None \
                 else float("nan")
@@ -939,7 +1023,10 @@ class Router:
                 overuse_pct=100.0 * n_over / max(1, N),
                 crit_path_delay=cpd))
             self._obs_window(tw0, it_done, K, n_over, over_total,
-                             len(dirty), w_steps, pres, cpd, int(nexec))
+                             len(dirty), w_steps, pres, cpd, int(nexec),
+                             relax_useful=w_useful,
+                             bucket_occ=bucket_occ,
+                             compaction=comp_num / max(1, comp_den))
             if analyzer is not None and cpd == cpd:
                 analyzer.crit_path_delay = cpd
             if mlog.enabled:
@@ -1079,7 +1166,6 @@ class Router:
                         finish_done=finish_done,
                         budget_full=budget_full.copy(),
                         widened_nets=result.widened_nets,
-                        crop_cw=crop_cw, crop_ch=crop_ch,
                         crop_full=crop_full))
                 next_ckpt = it_done + opts.checkpoint_every
                 mlog.log("elastic", event="checkpoint",
@@ -1414,6 +1500,10 @@ class Router:
                         L_e = L_cap
                 stall = 0
             result.total_relax_steps += it_steps
+            # the ELL program has no per-sweep convergence measurement:
+            # its steps count as useful so the ledger invariant
+            # (useful + wasted == total) holds across both programs
+            result.total_relax_steps_useful += it_steps
             result.stats.append(RouteStats(
                 it, n_over, over_total, len(idx), time.time() - t0,
                 relax_steps=it_steps, batches=len(batches),

@@ -16,8 +16,13 @@ tunnel); pass --tpu to use the chip.
 """
 
 import argparse
+import os
 import sys
 import time
+
+# keep the TSL host-CPU-features WARNING out of the captured stderr
+# (same guard as bench.py; must precede jax/TSL init)
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 
 
 def log(m):
@@ -126,6 +131,10 @@ def main():
               f"{t_route:.0f}s wall ({'tpu' if args.tpu else 'cpu'} backend), "
               f"{res.total_net_routes} net-routes "
               f"({res.total_net_routes/t_route:.1f} nets/s)")
+        print(f"- work ledger: {res.total_relax_steps} relax sweeps = "
+              f"{res.total_relax_steps_useful} useful + "
+              f"{res.total_relax_steps_wasted} wasted "
+              f"({res.total_relax_steps_cropped} in cropped tiles)")
         print(f"- legality: verified by the independent checker (run_route)")
         print(f"- obs: {res.iterations} route iterations, overuse "
               f"trajectory {[s.overused_nodes for s in res.stats]}, "

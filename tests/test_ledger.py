@@ -1,0 +1,132 @@
+"""Work-efficiency ledger smoke tests (fast, `pytest -m ledger`).
+
+The ledger splits every relaxation sweep the device executed into
+useful (improved some distance) and wasted (fixpoint discovery); the
+invariant useful + wasted == total must hold exactly — the device
+measures both sides of the split in the same while_loop carry, so a
+mismatch means a dispatch path dropped its stats.
+
+Also wires tools/ledger_report.py --check into the suite: the checker
+must accept the registry dump of a real route and reject a dump whose
+invariant is broken.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.flow import synth_flow
+from parallel_eda_tpu.obs import get_metrics
+from parallel_eda_tpu.route import Router, RouterOpts
+
+LEDGER_TOOL = Path(__file__).resolve().parent.parent / "tools" / \
+    "ledger_report.py"
+
+
+@pytest.fixture(scope="module")
+def routed():
+    """One tiny CPU route shared by the module: RouteResult + the
+    registry dump taken right after it."""
+    reg = get_metrics()
+    reg.reset()
+    reg.enabled = True
+    try:
+        f = synth_flow(num_luts=15, chan_width=10, seed=0)
+        res = Router(f.rr, RouterOpts(batch_size=16)).route(f.term)
+        values = reg.values("route.")
+        snapshots = [s for s in reg.snapshots]
+        doc = {"values": reg.values(), "snapshots": snapshots}
+    finally:
+        reg.enabled = False
+    return res, values, doc
+
+
+@pytest.mark.ledger
+def test_ledger_invariant(routed):
+    res, _, _ = routed
+    assert res.success
+    assert res.total_relax_steps > 0
+    assert res.total_relax_steps_useful > 0
+    assert (res.total_relax_steps_useful + res.total_relax_steps_wasted
+            == res.total_relax_steps)
+
+
+@pytest.mark.ledger
+def test_registry_counters_match_result(routed):
+    res, values, _ = routed
+    assert values.get("route.relax_steps") == res.total_relax_steps
+    assert values.get("route.relax_steps_useful") == \
+        res.total_relax_steps_useful
+    assert values.get("route.relax_steps_wasted") == \
+        res.total_relax_steps_wasted
+    wf = values.get("route.relax_wasted_frac")
+    assert wf is not None and abs(
+        wf - res.total_relax_steps_wasted / res.total_relax_steps) < 1e-3
+
+
+@pytest.mark.ledger
+def test_early_exit_beats_ceiling(routed):
+    """The on-device convergence exit must actually fire: on this tiny
+    fixture the fixpoint lands well before the static sweep ceiling, so
+    some executed sweeps are wasted (exactly one fixpoint-discovery
+    sweep per relax call) but far fewer than the old fixed-trip-count
+    program would have burned."""
+    res, _, _ = routed
+    assert res.total_relax_steps_wasted > 0
+    assert res.total_relax_steps_wasted < res.total_relax_steps
+
+
+@pytest.mark.ledger
+def test_ledger_report_check_accepts_real_dump(routed, tmp_path):
+    _, _, doc = routed
+    p = tmp_path / "metrics.json"
+    p.write_text(json.dumps(doc))
+    r = subprocess.run([sys.executable, str(LEDGER_TOOL), str(p),
+                        "--check"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+@pytest.mark.ledger
+def test_ledger_report_summarize_runs(routed, tmp_path):
+    _, _, doc = routed
+    p = tmp_path / "metrics.json"
+    p.write_text(json.dumps(doc))
+    r = subprocess.run([sys.executable, str(LEDGER_TOOL), str(p)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "work-efficiency ledger" in r.stdout
+    assert "useful" in r.stdout
+
+
+@pytest.mark.ledger
+def test_ledger_report_check_rejects_broken_invariant(tmp_path):
+    doc = {"values": {"route.relax_steps": 100,
+                      "route.relax_steps_useful": 90,
+                      "route.relax_steps_wasted": 20},
+           "snapshots": []}
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(doc))
+    r = subprocess.run([sys.executable, str(LEDGER_TOOL), str(p),
+                        "--check"], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "invariant" in r.stderr
+
+
+@pytest.mark.ledger
+def test_ledger_report_check_rejects_missing_and_garbage(tmp_path):
+    p = tmp_path / "missing.json"
+    p.write_text(json.dumps({"values": {}}))
+    r = subprocess.run([sys.executable, str(LEDGER_TOOL), str(p),
+                        "--check"], capture_output=True, text=True)
+    assert r.returncode == 1
+
+    g = tmp_path / "garbage.json"
+    g.write_text("{not json")
+    r = subprocess.run([sys.executable, str(LEDGER_TOOL), str(g),
+                        "--check"], capture_output=True, text=True)
+    assert r.returncode == 2

@@ -42,9 +42,9 @@ def test_pallas_matches_xla(arch, nx, ny, seed):
                        .astype(np.float32))
     w0 = jnp.zeros((B, pg.ncells), jnp.float32)
 
-    d_x, p_x, w_x = planes_relax(pg, d0, cc, crit, w0, 12)
-    d_p, p_p, w_p = planes_relax_pallas(pg, d0, cc, crit, w0, 12,
-                                        interpret=True)
+    d_x, p_x, w_x, _ = planes_relax(pg, d0, cc, crit, w0, 12)
+    d_p, p_p, w_p, _ = planes_relax_pallas(pg, d0, cc, crit, w0, 12,
+                                           interpret=True)
     a, b = np.asarray(d_x), np.asarray(d_p)
     # distances agree to the ulp (the only residue is FMA contraction
     # differences between the XLA and interpret lowerings of

@@ -116,7 +116,7 @@ def test_unidir_planes_relax_matches_ell(arch, nx, ny, seed):
 
     noc = np.asarray(pg.node_of_cell)
     d0 = np.where(seed_m[:, noc], 0.0, np.inf).astype(np.float32)
-    dist_flat, pred, _ = planes_relax(
+    dist_flat, pred, _, _ = planes_relax(
         pg, jnp.asarray(d0), jnp.asarray(cong_m[:, noc]),
         jnp.asarray(crit)[:, :, None, None],
         jnp.zeros((B, pg.ncells), jnp.float32), 64)

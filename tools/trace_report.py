@@ -118,6 +118,18 @@ def summarize(doc) -> str:
             lines.append("  (~ = iteration inside a fused K>1 device "
                          "window; wall time evenly attributed)")
 
+    windows = [e for e in evs if e.get("name") == "route.window"]
+    w_tot = sum(e.get("args", {}).get("relax_steps", 0)
+                for e in windows)
+    w_use = sum(e.get("args", {}).get("relax_steps_useful", 0)
+                for e in windows)
+    w_was = sum(e.get("args", {}).get("relax_steps_wasted", 0)
+                for e in windows)
+    if w_tot and (w_use or w_was):
+        lines.append(f"relax-sweep ledger: {w_tot} executed = "
+                     f"{w_use} useful + {w_was} wasted "
+                     f"({w_was / w_tot:.1%} wasted)")
+
     compile_us = sum(e["dur"] for e in evs
                      if e.get("cat") == "jax.compile")
     total_us = max((e["ts"] + e["dur"] for e in evs), default=0)
