@@ -272,12 +272,31 @@ def sweep_microbench(args) -> None:
         else:
             cells = B * pg.ncells
         util = cells / dt / hbm_bound_rate
+        # kernel-layout rider: what the packed planner would run at this
+        # shape (mirrors Router._plan_block_nets / route.kernel.* gauges)
+        from parallel_eda_tpu.route.planes_pallas import (
+            auto_block_nets, packed_layout, unpacked_lane_occupancy)
+        if args.sweep_crop:
+            t = min(args.sweep_crop, nx - 1)
+            shx, shy = (W, t, t + 1), (W, t + 1, t)
+        else:
+            shx, shy = pg.shape_x, pg.shape_y
+        if args.program == "planes_pallas":
+            g = auto_block_nets(shx, shy, B)
+            kernel = {"variant": "pallas_packed", "block_nets": g,
+                      "lane_occupancy": round(
+                          packed_layout(shx, shy).lane_occupancy(g), 4)}
+        else:
+            kernel = {"variant": "xla", "block_nets": 1,
+                      "lane_occupancy": round(
+                          unpacked_lane_occupancy(shx, shy), 4)}
         rows.append({"grid": f"{nx}x{nx}", "W": W, "cells": pg.ncells,
                      "ms_per_sweep": round(dt * 1e3, 3),
                      "cell_rate_G": round(cells / dt / 1e9, 3),
                      "hbm_bound_cell_rate_G": round(
                          hbm_bound_rate / 1e9, 2),
-                     "bw_utilization": round(util, 4)})
+                     "bw_utilization": round(util, 4),
+                     "kernel": kernel})
         note = ("VMEM-resident roofline" if args.program ==
                 "planes_pallas" else "HBM roofline of the XLA lowering")
         log(f"sweep {nx}x{nx} W={W} B={B}: {dt * 1e3:.2f} ms/sweep, "
@@ -642,6 +661,17 @@ def main():
                 "relax_wasted_frac": mv.get("route.relax_wasted_frac"),
                 "wirelength_vs_serial": mv.get(
                     "route.wirelength_vs_serial"),
+            },
+            # kernel-layout ledger (route.kernel.* gauges, set by the
+            # router's block planner for the dominant window shape):
+            # how many nets each grid step packs and the model-side
+            # lane occupancy / HBM traffic that implies
+            "kernel": {
+                "packed_block_size": mv.get(
+                    "route.kernel.packed_block_size"),
+                "lane_occupancy": mv.get("route.kernel.lane_occupancy"),
+                "bytes_per_sweep": mv.get(
+                    "route.kernel.bytes_per_sweep"),
             },
             # obs rider (obs.metrics / obs.trace): per-iteration
             # overuse trajectory + compile-vs-execute attribution of

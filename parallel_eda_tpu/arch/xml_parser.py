@@ -282,7 +282,10 @@ def read_arch_xml(path: str) -> Arch:
                     pb_tree_parsed = parse_pb_type(cluster_pb)
                     from ..pack.pb_pack import validate_pb_tree
                     validate_pb_tree(pb_tree_parsed)
-                except Exception as e:   # structure/spec not supported
+                except (ValueError, KeyError) as e:
+                    # structure/spec not supported -> flat-crossbar
+                    # fallback; any OTHER exception is a parser bug and
+                    # must propagate, not silently degrade packing
                     warnings.warn(
                         f"{path}: multi-mode cluster pb_type not "
                         f"representable ({type(e).__name__}: {e}); "

@@ -99,6 +99,13 @@ class MetricsRegistry:
             h = self._hists[name] = Histogram()
         return h
 
+    def set_gauges(self, values: Dict[str, object]) -> None:
+        """Set a family of related gauges in one call (e.g. the
+        route.kernel.* layout triple) so call sites cannot drift into
+        setting half a family."""
+        for name, v in values.items():
+            self.gauge(name).set(v)
+
     def values(self, prefix: str = "") -> dict:
         """Current value of every instrument (histograms summarized)."""
         out = {}

@@ -962,6 +962,43 @@ def scatter_state(gm_full: PlanesGeom, fulls, tiles, ox, oy):
             flat(put(wxf, wx), put(wyf, wy)))
 
 
+# ---------------------------------------------------------------------------
+# Packed canvas storage (lane folding) — shared with the Pallas kernels.
+#
+# The packed kernels store each net's canvases as ONE row: the track dim
+# W and the spatial dims fold into the minor axis, with the trailing Y
+# extent padded to a lane multiple first, so a block of G nets becomes a
+# [G, row] array whose (8, 128) f32 vector registers carry G nets' rows
+# at high occupancy.  The one-net-per-step [1, W, X, Y] layout instead
+# tiles (X, Y) onto (8, 128): a bench-sized Y extent (~13) fills a
+# sliver of the 128 lanes.
+#
+# The pad columns are storage-only: compute always slices back to the
+# unpadded (W, X, Y) canvas before the sweep body runs, so the fold
+# cannot perturb numerics.  The XLA program deliberately KEEPS the
+# unpadded layout: padding an associative_scan axis changes the fold's
+# combine-tree shape and therefore the float associativity of the
+# min-plus reduction — the two lowerings would no longer be
+# bit-comparable (and the pad cells could leak turn candidates).
+# ---------------------------------------------------------------------------
+
+
+def fold_canvas(a, pad_y: int = 0):
+    """[B, ..., Y] -> [B, prod(...) * (Y + pad_y)]: pad the trailing
+    axis with storage-only columns, then flatten each net to one row."""
+    if pad_y:
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad_y)])
+    return a.reshape(a.shape[0], -1)
+
+
+def unfold_canvas(a2, shape, pad_y: int = 0):
+    """Inverse of fold_canvas: [B, row] -> [B, *shape], pad dropped."""
+    B = a2.shape[0]
+    padded = tuple(shape[:-1]) + (shape[-1] + pad_y,)
+    a = a2.reshape((B,) + padded)
+    return a[..., :shape[-1]] if pad_y else a
+
+
 def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
                          wenter0, nsweeps: int, ox, oy,
                          cnx: int, cny: int):

@@ -1,40 +1,186 @@
-"""Pallas TPU kernel for the planes relaxation: the whole multi-sweep
-loop VMEM-resident, one net per grid step.
+"""Pallas TPU kernels for the planes relaxation: the whole multi-sweep
+loop VMEM-resident, a BLOCK of G nets per grid step, canvases packed
+along the sublane/lane dimensions.
 
-Why this kernel exists (the round-3/4 perf plan): the XLA lowering of
-planes_relax materialises every scan/turn intermediate through HBM —
-per sweep that is ~15 canvas-sized reads+writes, so the sweep is
-HBM-bandwidth-bound.  One net's full state (dist/pred/wenter for both
-plane sets, the congestion canvases, and the static masks/delays) is a
-few MB for BASELINE-ladder devices — it FITS IN VMEM (~16 MB/core).
-This kernel grids over the batch and runs the ENTIRE nsweeps loop on
-one net's canvases without touching HBM in between: HBM traffic drops
-from O(nsweeps * canvases) to O(canvases).
+Two perf levers compose here:
 
-The sweep body is the SAME code as the XLA program (_sweep_once /
-_sweep_costs from planes.py, including the directional gating) — the
-two lowerings cannot drift.  Correctness is enforced by
-tests/test_planes_pallas.py in interpret mode (this container's TPU
-tunnel was down all round; the kernel is opt-in via
+* VMEM residency (rounds 3/4): the XLA lowering of planes_relax
+  materialises every scan/turn intermediate through HBM — per sweep
+  that is ~15 canvas-sized reads+writes, so the sweep is
+  HBM-bandwidth-bound.  The kernel runs the ENTIRE nsweeps loop on
+  VMEM-resident canvases: HBM traffic drops from O(nsweeps * canvases)
+  to O(canvases).
+
+* Lane packing (this round): one bench-sized net fills a sliver of the
+  (8, 128) f32 vector registers — a 12x12 / W=12 canvas laid out
+  [1, W, NX, NY+1] puts NY+1 = 13 of 128 lanes to work.  Each net's
+  canvases are therefore stored as ONE folded row (planes.fold_canvas:
+  W and the spatial dims collapse into the minor axis, trailing Y
+  padded to a lane multiple) and a grid step loads a [G, row] block —
+  G nets across the sublanes, full-width lanes.  G is planned from the
+  VMEM budget (auto_block_nets, sized per crop-ladder rung); when one
+  rung's padded block would overflow, G degrades toward 1 and the grid
+  pipeline's double-buffered HBM->VMEM copies stream the blocks.
+
+The pad columns are storage-only.  Inside the kernel every canvas is
+sliced back to its unpadded (W, X, Y) shape before the shared sweep
+body runs (_sweep_once / _sweep_costs from planes.py — the same code as
+the XLA program, the two lowerings cannot drift), so the packed kernels
+are BIT-IDENTICAL to the one-net-per-step path (block_nets=1,
+lane_mult=1) and to each other for any G: padding an associative_scan
+axis instead would change the min-plus fold's combine tree and break
+that equivalence.  Batch remainders are padded with inert nets
+(d0 = +inf everywhere — no scan or turn can improve an all-inf canvas —
+congestion 0, crit 0) whose outputs are sliced off.  The [executed,
+useful] convergence counters thread through unchanged: a block's
+while_loop stops at the max of its member nets' trip counts, so the
+batch-level max over blocks equals the max over nets — exactly the
+reduction the equivalent batched while_loop applies.
+
+Correctness is enforced by tests/test_planes_pallas.py and the packed
+parity suite in tests/test_kernel_pack.py in interpret mode (the kernel
+auto-selects the interpreter off-TPU; it stays opt-in via
 RouterOpts(program="planes_pallas") until device-measured).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 
-from .planes import (PlanesGeom, PlanesGraph, _run_relax, _sweep_costs,
-                     _sweep_once, crop_state, geom_cropped, geom_full,
-                     scatter_state)
+from .planes import (INF, PlanesGeom, PlanesGraph, _run_relax,
+                     _sweep_costs, _sweep_once, crop_state, fold_canvas,
+                     geom_cropped, geom_full, scatter_state,
+                     unfold_canvas)
+
+# f32 vector-register geometry (TPU: 8 sublanes x 128 lanes)
+SUBLANE = 8
+LANE = 128
+DEF_LANE_MULT = 8           # trailing-Y pad granularity for packed rows
+# VMEM plan budget: ~16 MB/core minus headroom for the grid pipeline's
+# scratch and compiler spills
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+# canvas-pair-equivalents of VMEM one net occupies during the in-kernel
+# sweep loop: 6 state inputs + 6 outputs double-buffered by the grid
+# pipeline (24) plus ~16 live scan/turn intermediates in the sweep body
+CANVAS_EQUIV = 40
 
 
-def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
-                  # refs: per-net state
+def _ceil_to(n: int, m: int) -> int:
+    return -(-int(n) // int(m)) * int(m)
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (max(1, int(n)).bit_length() - 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedLayout:
+    """Storage layout of one net's canvas pair after lane folding: the
+    x-plane set (W, X, Y+1) and y-plane set (W, X+1, Y) each flatten to
+    one row of row_x / row_y elements, trailing Y padded up to
+    lane_mult.  All occupancy / footprint modeling (kernel planning,
+    route.kernel.* gauges, tools/kernel_bench.py) derives from this one
+    object so the numbers agree everywhere."""
+    shape_x: tuple
+    shape_y: tuple
+    lane_mult: int = DEF_LANE_MULT
+
+    @property
+    def pad_yx(self) -> int:
+        return _ceil_to(self.shape_x[-1], self.lane_mult) \
+            - self.shape_x[-1]
+
+    @property
+    def pad_yy(self) -> int:
+        return _ceil_to(self.shape_y[-1], self.lane_mult) \
+            - self.shape_y[-1]
+
+    @property
+    def row_x(self) -> int:
+        W, X, Y = self.shape_x
+        return W * X * (Y + self.pad_yx)
+
+    @property
+    def row_y(self) -> int:
+        W, X, Y = self.shape_y
+        return W * X * (Y + self.pad_yy)
+
+    @property
+    def cells(self) -> int:
+        """Useful (unpadded) cells across both plane sets."""
+        (W, X, Y), (_, X2, Y2) = self.shape_x, self.shape_y
+        return W * X * Y + W * X2 * Y2
+
+    @property
+    def padded_cells(self) -> int:
+        return self.row_x + self.row_y
+
+    def block_bytes(self, G: int) -> int:
+        """Modeled VMEM bytes of a G-net block while the sweep loop
+        runs (f32 canvases x CANVAS_EQUIV live pairs per net)."""
+        return int(G) * CANVAS_EQUIV * 4 * self.padded_cells
+
+    def lane_occupancy(self, G: int) -> float:
+        """Useful-cell fraction of the vreg footprint of a [G, row]
+        block: G rows over ceil-to-8 sublanes, rows over ceil-to-128
+        lanes."""
+        sub = _ceil_to(max(int(G), 1), SUBLANE)
+        lanes = _ceil_to(self.row_x, LANE) + _ceil_to(self.row_y, LANE)
+        return (int(G) * self.cells) / float(sub * lanes)
+
+
+def packed_layout(shape_x, shape_y,
+                  lane_mult: int = DEF_LANE_MULT) -> PackedLayout:
+    return PackedLayout(tuple(shape_x), tuple(shape_y), int(lane_mult))
+
+
+def auto_block_nets(shape_x, shape_y, nnets: int,
+                    lane_mult: int = DEF_LANE_MULT,
+                    vmem_bytes: int = VMEM_BUDGET_BYTES) -> int:
+    """Largest power-of-two block of nets whose packed state fits the
+    VMEM plan budget, clamped to the batch.  Never below 1: a single
+    net that overflows the budget still runs — the grid pipeline
+    streams its block with double-buffered HBM->VMEM copies."""
+    lay = packed_layout(shape_x, shape_y, lane_mult)
+    per_net = max(1, lay.block_bytes(1))
+    g = max(1, vmem_bytes // per_net)
+    return _pow2_floor(min(g, max(1, int(nnets))))
+
+
+def unpacked_lane_occupancy(shape_x, shape_y) -> float:
+    """Vreg occupancy model of the legacy one-net-per-step layout:
+    [1, W, X, Y] blocks tile (X, Y) onto (8, 128), so the whole Y
+    extent of a small canvas sits in one vreg's first lanes."""
+    (W, X, Y), (_, X2, Y2) = tuple(shape_x), tuple(shape_y)
+    tiled = (W * _ceil_to(X, SUBLANE) * _ceil_to(Y, LANE)
+             + W * _ceil_to(X2, SUBLANE) * _ceil_to(Y2, LANE))
+    return (W * X * Y + W * X2 * Y2) / float(tiled)
+
+
+def _load_packed(ref, G: int, shape, pad_y: int):
+    """[G, row] ref -> unpadded [G, *shape] value (pad columns are
+    storage-only and never reach compute)."""
+    padded = (G,) + tuple(shape[:-1]) + (shape[-1] + pad_y,)
+    v = ref[:].reshape(padded)
+    return v[..., :shape[-1]] if pad_y else v
+
+
+def _store_packed(ref, a, pad_y: int):
+    """Unpadded [G, *shape] value -> [G, row] ref (pad columns
+    zero-filled so the stored block is fully defined)."""
+    if pad_y:
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad_y)])
+    ref[:] = a.reshape(ref.shape)
+
+
+def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int, G: int,
+                  pad_yx: int, pad_yy: int,
+                  # refs: per-net state, folded [G, row]
                   dx_ref, dy_ref, ccx_ref, ccy_ref, crit_ref, wx_ref,
                   wy_ref,
                   # refs: static planes metadata (same block for all b)
@@ -44,11 +190,14 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
                   # outputs
                   odx_ref, ody_ref, opx_ref, opy_ref, owx_ref, owy_ref,
                   ost_ref):
-    """One grid step = one net: load canvases into VMEM values, rebuild
-    a PlanesGeom view over the loaded masks, run the shared sweep body
-    nsweeps times, store results."""
-    W, NX, NYp1 = pg_template.shape_x
-    _, NXp1, NY = pg_template.shape_y
+    """One grid step = one BLOCK of G nets, each net's canvases stored
+    as one folded row: unpack to unpadded canvases, rebuild a shared
+    PlanesGeom over the (unpadded) static masks, run the shared sweep
+    body to the block's fixpoint, re-fold and store."""
+    shx = pg_template.shape_x
+    shy = pg_template.shape_y
+    W, NX, NYp1 = shx
+    _, NXp1, NY = shy
     ncx = W * NX * NYp1
 
     idxx = jnp.arange(ncx, dtype=jnp.int32).reshape(1, W, NX, NYp1)
@@ -70,13 +219,13 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
         inc_track=(inc_ref[:] != 0 if pg_template.directional else None),
     )
 
-    dx = dx_ref[:]                      # [1, W, NX, NYp1]
-    dy = dy_ref[:]
-    cc_x = ccx_ref[:]
-    cc_y = ccy_ref[:]
-    crit_c = crit_ref[:].reshape(1, 1, 1, 1)
-    wx = wx_ref[:]
-    wy = wy_ref[:]
+    dx = _load_packed(dx_ref, G, shx, pad_yx)
+    dy = _load_packed(dy_ref, G, shy, pad_yy)
+    cc_x = _load_packed(ccx_ref, G, shx, pad_yx)
+    cc_y = _load_packed(ccy_ref, G, shy, pad_yy)
+    crit_c = crit_ref[:].reshape(G, 1, 1, 1)
+    wx = _load_packed(wx_ref, G, shx, pad_yx)
+    wy = _load_packed(wy_ref, G, shy, pad_yy)
 
     predx = jnp.broadcast_to(gm.idxx, dx.shape)
     predy = jnp.broadcast_to(gm.idxy, dy.shape)
@@ -86,46 +235,73 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int,
     def body(s):
         return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
-    # per-net bounded while_loop: this net stops sweeping at ITS OWN
-    # fixpoint (the XLA batched program can only stop at the batch's)
+    # per-block bounded while_loop: the block stops at its members'
+    # common fixpoint — the max of the member nets' own trip counts,
+    # the same reduction the batched XLA while_loop applies batch-wide
     (dx, dy, predx, predy, wx, wy), stats = _run_relax(
         body, (dx, dy, predx, predy, wx, wy), nsweeps)
 
-    odx_ref[:] = dx
-    ody_ref[:] = dy
-    opx_ref[:] = predx
-    opy_ref[:] = predy
-    owx_ref[:] = wx
-    owy_ref[:] = wy
+    _store_packed(odx_ref, dx, pad_yx)
+    _store_packed(ody_ref, dy, pad_yy)
+    _store_packed(opx_ref, predx, pad_yx)
+    _store_packed(opy_ref, predy, pad_yy)
+    _store_packed(owx_ref, wx, pad_yx)
+    _store_packed(owy_ref, wy, pad_yy)
     ost_ref[:] = stats.reshape(1, 2)
 
 
-@functools.partial(jax.jit, static_argnames=("nsweeps", "interpret"))
+def _bpad(a, n: int, fill=0):
+    """Pad the batch axis with n inert rows."""
+    if n <= 0:
+        return a
+    return jnp.pad(a, [(0, n)] + [(0, 0)] * (a.ndim - 1),
+                   constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("nsweeps", "interpret",
+                                             "block_nets", "lane_mult"))
 def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
-                        wenter0, nsweeps: int, interpret=None):
+                        wenter0, nsweeps: int, interpret=None,
+                        block_nets=None, lane_mult: int = DEF_LANE_MULT):
     """Drop-in for planes.planes_relax with identical signature and
-    results, lowered as a Pallas kernel gridded over the batch.
-    interpret=None auto-selects the interpreter off-TPU (tests/CPU)."""
+    bit-identical results, lowered as a Pallas kernel gridded over
+    BLOCKS of nets.  interpret=None auto-selects the interpreter
+    off-TPU (tests/CPU); block_nets=None auto-plans the block size from
+    the VMEM budget; block_nets=1 + lane_mult=1 is the legacy
+    one-net-per-step layout."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B = d0_flat.shape[0]
     W, NX, NYp1 = pg.shape_x
     _, NXp1, NY = pg.shape_y
     ncx = W * NX * NYp1
-
     shx = (W, NX, NYp1)
     shy = (W, NXp1, NY)
-    dx0 = d0_flat[:, :ncx].reshape(B, *shx)
-    dy0 = d0_flat[:, ncx:].reshape(B, *shy)
-    ccx = cc_flat[:, :ncx].reshape(B, *shx)
-    ccy = cc_flat[:, ncx:].reshape(B, *shy)
-    wx0 = wenter0[:, :ncx].reshape(B, *shx)
-    wy0 = wenter0[:, ncx:].reshape(B, *shy)
-    critb = crit_c.reshape(B, 1)
 
-    def bspec(shape):
-        return pl.BlockSpec((1,) + shape,
-                            lambda b: (b,) + (0,) * len(shape))
+    lay = packed_layout(shx, shy, lane_mult)
+    G = (auto_block_nets(shx, shy, B, lane_mult)
+         if block_nets is None else int(block_nets))
+    G = max(1, min(G, B))
+    NB = -(-B // G)
+    Bp = NB * G
+    pyx, pyy = lay.pad_yx, lay.pad_yy
+
+    def prep(part, shape, pad_y, fill):
+        return _bpad(fold_canvas(part.reshape((B,) + shape), pad_y),
+                     Bp - B, fill)
+
+    # inert batch-pad nets: d0 = +inf everywhere (no scan or turn can
+    # improve an all-inf canvas), congestion/wenter/crit 0
+    dx0 = prep(d0_flat[:, :ncx], shx, pyx, INF)
+    dy0 = prep(d0_flat[:, ncx:], shy, pyy, INF)
+    ccx = prep(cc_flat[:, :ncx], shx, pyx, 0)
+    ccy = prep(cc_flat[:, ncx:], shy, pyy, 0)
+    wx0 = prep(wenter0[:, :ncx], shx, pyx, 0)
+    wy0 = prep(wenter0[:, ncx:], shy, pyy, 0)
+    critb = _bpad(crit_c.reshape(B, 1), Bp - B, 0)
+
+    def rowspec(row):
+        return pl.BlockSpec((G, row), lambda b: (b, 0))
 
     def sspec(shape):
         # static metadata: every grid step reads block 0
@@ -143,73 +319,78 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
     static_specs = [sspec(a.shape) for a in statics]
 
     f32 = jnp.float32
-    out_shapes = [jax.ShapeDtypeStruct((B,) + shx, f32),
-                  jax.ShapeDtypeStruct((B,) + shy, f32),
-                  jax.ShapeDtypeStruct((B,) + shx, jnp.int32),
-                  jax.ShapeDtypeStruct((B,) + shy, jnp.int32),
-                  jax.ShapeDtypeStruct((B,) + shx, f32),
-                  jax.ShapeDtypeStruct((B,) + shy, f32),
-                  jax.ShapeDtypeStruct((B, 2), jnp.int32)]
-    out_specs = [bspec(shx), bspec(shy), bspec(shx), bspec(shy),
-                 bspec(shx), bspec(shy), bspec((2,))]
+    rx, ry = lay.row_x, lay.row_y
+    out_shapes = [jax.ShapeDtypeStruct((Bp, rx), f32),
+                  jax.ShapeDtypeStruct((Bp, ry), f32),
+                  jax.ShapeDtypeStruct((Bp, rx), jnp.int32),
+                  jax.ShapeDtypeStruct((Bp, ry), jnp.int32),
+                  jax.ShapeDtypeStruct((Bp, rx), f32),
+                  jax.ShapeDtypeStruct((Bp, ry), f32),
+                  jax.ShapeDtypeStruct((NB, 2), jnp.int32)]
+    out_specs = [rowspec(rx), rowspec(ry), rowspec(rx), rowspec(ry),
+                 rowspec(rx), rowspec(ry),
+                 pl.BlockSpec((1, 2), lambda b: (b, 0))]
 
-    kern = functools.partial(_sweep_kernel, pg, nsweeps)
+    kern = functools.partial(_sweep_kernel, pg, nsweeps, G, pyx, pyy)
     dx, dy, px, py, wx, wy, stats = pl.pallas_call(
         kern,
-        grid=(B,),
-        in_specs=[bspec(shx), bspec(shy), bspec(shx), bspec(shy),
-                  pl.BlockSpec((1, 1), lambda b: (b, 0)),
-                  bspec(shx), bspec(shy)] + static_specs,
+        grid=(NB,),
+        in_specs=[rowspec(rx), rowspec(ry), rowspec(rx), rowspec(ry),
+                  pl.BlockSpec((G, 1), lambda b: (b, 0)),
+                  rowspec(rx), rowspec(ry)] + static_specs,
         out_shape=out_shapes,
         out_specs=out_specs,
         interpret=interpret,
     )(dx0, dy0, ccx, ccy, critb, wx0, wy0, *statics)
 
-    def flat(a, b):
-        return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
+    def flat(ax, ay):
+        ax = unfold_canvas(ax, shx, pyx)[:B]
+        ay = unfold_canvas(ay, shy, pyy)[:B]
+        return jnp.concatenate([ax.reshape(B, -1), ay.reshape(B, -1)],
                                axis=1)
 
-    # batch-level stats: the slowest net's trip count — what the
-    # equivalent batched while_loop would have executed
+    # batch-level stats: the slowest block's trip count == the slowest
+    # net's (all-pad blocks cannot exist: the last block holds >= 1
+    # real net, and pad nets converge after the discovery sweep)
     bstats = jnp.stack([stats[:, 0].max(), stats[:, 1].max()])
     return flat(dx, dy), flat(px, py), flat(wx, wy), bstats
 
 
 def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
-                       # per-net state tiles
-                       dx_ref, dy_ref, ccx_ref, ccy_ref, crit_ref,
-                       wx_ref, wy_ref,
-                       # per-net cropped geometry tiles
-                       bbx_ref, bax_ref, bby_ref, bay_ref,
-                       fx_ref, lx_ref, fy_ref, ly_ref,
-                       delx_ref, dely_ref, delr0_ref, delr1_ref,
-                       idxx_ref, idxy_ref, par_ref, inc_ref,
-                       # outputs
-                       odx_ref, ody_ref, opx_ref, opy_ref, owx_ref,
-                       owy_ref, ost_ref):
-    """One grid step = one net's bb TILE, whole nsweeps loop in VMEM.
-    Geometry arrives pre-cropped (geom_cropped computes the per-net
-    slices in XLA), so every block here is tile-shaped and the kernel
-    body is the same shared sweep code."""
+                       G: int, shx, shy, pad_yx: int, pad_yy: int,
+                       geo_meta, *refs):
+    """One grid step = a BLOCK of G nets' bb TILES, whole nsweeps loop
+    in VMEM.  Geometry arrives pre-cropped per net (geom_cropped runs
+    in XLA) and folded to [G, row] like the state; geo_meta carries
+    each geometry array's unpadded tile shape + trailing pad."""
+    (dx_ref, dy_ref, ccx_ref, ccy_ref, crit_ref,
+     wx_ref, wy_ref) = refs[:7]
+    geo_refs = refs[7:7 + len(geo_meta)]
+    inc_ref = refs[7 + len(geo_meta)]
+    (odx_ref, ody_ref, opx_ref, opy_ref, owx_ref, owy_ref,
+     ost_ref) = refs[-7:]
+
+    (bbx, bax, bby, bay, fx, lxm, fy, lym, delx, dely, delr0, delr1,
+     idxx, idxy, par) = [_load_packed(r, G, shape, pad)
+                         for r, (shape, pad) in zip(geo_refs, geo_meta)]
     gm = PlanesGeom(
-        brk_before_x=bbx_ref[:] != 0, brk_after_x=bax_ref[:] != 0,
-        brk_before_y=bby_ref[:] != 0, brk_after_y=bay_ref[:] != 0,
-        first_x=fx_ref[:] != 0, last_x=lx_ref[:] != 0,
-        first_y=fy_ref[:] != 0, last_y=ly_ref[:] != 0,
-        delay_x=delx_ref[:], delay_y=dely_ref[:],
-        delay_y_rot0=delr0_ref[:], delay_y_rot1=delr1_ref[:],
-        idxx=idxx_ref[:], idxy=idxy_ref[:],
-        base_par=par_ref[:], stride_x=stride_x,
+        brk_before_x=bbx != 0, brk_after_x=bax != 0,
+        brk_before_y=bby != 0, brk_after_y=bay != 0,
+        first_x=fx != 0, last_x=lxm != 0,
+        first_y=fy != 0, last_y=lym != 0,
+        delay_x=delx, delay_y=dely,
+        delay_y_rot0=delr0, delay_y_rot1=delr1,
+        idxx=idxx, idxy=idxy, base_par=par, stride_x=stride_x,
         directional=directional,
         inc_track=(inc_ref[:] != 0 if directional else None),
     )
-    dx = dx_ref[:]
-    dy = dy_ref[:]
-    cc_x = ccx_ref[:]
-    cc_y = ccy_ref[:]
-    crit_c = crit_ref[:].reshape(1, 1, 1, 1)
-    wx = wx_ref[:]
-    wy = wy_ref[:]
+    dx = _load_packed(dx_ref, G, shx, pad_yx)
+    dy = _load_packed(dy_ref, G, shy, pad_yy)
+    cc_x = _load_packed(ccx_ref, G, shx, pad_yx)
+    cc_y = _load_packed(ccy_ref, G, shy, pad_yy)
+    crit_c = crit_ref[:].reshape(G, 1, 1, 1)
+    wx = _load_packed(wx_ref, G, shx, pad_yx)
+    wy = _load_packed(wy_ref, G, shy, pad_yy)
     predx = jnp.broadcast_to(gm.idxx, dx.shape)
     predy = jnp.broadcast_to(gm.idxy, dy.shape)
 
@@ -220,84 +401,122 @@ def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
 
     (dx, dy, predx, predy, wx, wy), stats = _run_relax(
         body, (dx, dy, predx, predy, wx, wy), nsweeps)
-    odx_ref[:] = dx
-    ody_ref[:] = dy
-    opx_ref[:] = predx
-    opy_ref[:] = predy
-    owx_ref[:] = wx
-    owy_ref[:] = wy
+    _store_packed(odx_ref, dx, pad_yx)
+    _store_packed(ody_ref, dy, pad_yy)
+    _store_packed(opx_ref, predx, pad_yx)
+    _store_packed(opy_ref, predy, pad_yy)
+    _store_packed(owx_ref, wx, pad_yx)
+    _store_packed(owy_ref, wy, pad_yy)
     ost_ref[:] = stats.reshape(1, 2)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("nsweeps", "cnx", "cny", "interpret"))
+                   static_argnames=("nsweeps", "cnx", "cny", "interpret",
+                                    "block_nets", "lane_mult"))
 def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
                                 crit_c, wenter0, nsweeps: int, ox, oy,
-                                cnx: int, cny: int, interpret=None):
-    """Drop-in for planes.planes_relax_cropped, with the whole
-    multi-sweep relaxation of each net's TILE resident in VMEM — the
-    composition of the two work-efficiency levers: per-net work scales
-    with the bb (crop) AND the sweep loop never touches HBM (Pallas).
-    One net tile's full state (~28 tile-sized arrays) is a few hundred
-    KB at bench tile sizes — far inside the ~16 MB VMEM budget.
+                                cnx: int, cny: int, interpret=None,
+                                block_nets=None,
+                                lane_mult: int = DEF_LANE_MULT):
+    """Drop-in for planes.planes_relax_cropped, with the multi-sweep
+    relaxation of a BLOCK of net TILES resident in VMEM — the
+    composition of all three work/hardware-efficiency levers: per-net
+    work scales with the bb (crop), the sweep loop never touches HBM
+    (Pallas), and the block's tiles pack the vector lanes (fold).
+    Block size is planned per crop-ladder rung (smaller tiles -> more
+    nets per block).
 
     Crop and scatter-back run in XLA exactly as in the XLA cropped
-    program; results match it to the same contract (bit-identical per
-    tile — same shapes, same sweep body, same fold order)."""
+    program; inside the kernel the folded tiles are sliced back to
+    their unpadded shapes, so results are bit-identical to the
+    one-net-per-step path for any block size."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B = d0_flat.shape[0]
     W, NX, NYp1 = pg.shape_x
+    shx = (W, cnx, cny + 1)
+    shy = (W, cnx + 1, cny)
+
+    lay = packed_layout(shx, shy, lane_mult)
+    G = (auto_block_nets(shx, shy, B, lane_mult)
+         if block_nets is None else int(block_nets))
+    G = max(1, min(G, B))
+    NB = -(-B // G)
+    Bp = NB * G
+    pyx, pyy = lay.pad_yx, lay.pad_yy
 
     gm_full = geom_full(pg)
     gm = geom_cropped(pg, ox, oy, cnx, cny, full=gm_full)
-    shx = (W, cnx, cny + 1)
-    shy = (W, cnx + 1, cny)
     fulls, (dx0, dy0, ccx, ccy, wx0, wy0) = crop_state(
         pg, d0_flat, cc_flat, wenter0, ox, oy, cnx, cny)
-    critb = crit_c.reshape(B, 1)
 
-    def bspec(shape):
-        return pl.BlockSpec((1,) + shape,
-                            lambda b: (b,) + (0,) * len(shape))
+    def prep(a4, pad_y, fill):
+        return _bpad(fold_canvas(a4, pad_y), Bp - B, fill)
+
+    dx0 = prep(dx0, pyx, INF)
+    dy0 = prep(dy0, pyy, INF)
+    ccx = prep(ccx, pyx, 0)
+    ccy = prep(ccy, pyy, 0)
+    wx0 = prep(wx0, pyx, 0)
+    wy0 = prep(wy0, pyy, 0)
+    critb = _bpad(crit_c.reshape(B, 1), Bp - B, 0)
 
     i8 = jnp.int8
     inc = (pg.inc_track.astype(i8) if pg.directional
            else jnp.zeros((W,), i8))
-    geo = (gm.brk_before_x.astype(i8), gm.brk_after_x.astype(i8),
-           gm.brk_before_y.astype(i8), gm.brk_after_y.astype(i8),
-           gm.first_x.astype(i8), gm.last_x.astype(i8),
-           gm.first_y.astype(i8), gm.last_y.astype(i8),
-           gm.delay_x, gm.delay_y, gm.delay_y_rot0, gm.delay_y_rot1,
-           gm.idxx, gm.idxy, gm.base_par.astype(jnp.int32))
-    geo_specs = [bspec(a.shape[1:]) for a in geo]
+    geo4 = (gm.brk_before_x.astype(i8), gm.brk_after_x.astype(i8),
+            gm.brk_before_y.astype(i8), gm.brk_after_y.astype(i8),
+            gm.first_x.astype(i8), gm.last_x.astype(i8),
+            gm.first_y.astype(i8), gm.last_y.astype(i8),
+            gm.delay_x, gm.delay_y, gm.delay_y_rot0, gm.delay_y_rot1,
+            gm.idxx, gm.idxy, gm.base_par.astype(jnp.int32))
+    lm = int(lane_mult)
+    geo_meta = tuple(
+        (tuple(a.shape[1:]),
+         _ceil_to(a.shape[-1], lm) - a.shape[-1]) for a in geo4)
+    # inert batch-pad geometry: all-zero masks/delays/ids — with the
+    # pad nets' all-inf d0 no cell can ever improve
+    geo_in = [_bpad(fold_canvas(a, p), Bp - B, 0)
+              for a, (_, p) in zip(geo4, geo_meta)]
+
+    def rowspec(row):
+        return pl.BlockSpec((G, row), lambda b: (b, 0))
+
+    geo_specs = [rowspec(a.shape[1]) for a in geo_in]
     # inc is shared across nets: every grid step reads block 0
     inc_spec = pl.BlockSpec((W,), lambda b: (0,))
 
     f32 = jnp.float32
-    out_shapes = [jax.ShapeDtypeStruct((B,) + shx, f32),
-                  jax.ShapeDtypeStruct((B,) + shy, f32),
-                  jax.ShapeDtypeStruct((B,) + shx, jnp.int32),
-                  jax.ShapeDtypeStruct((B,) + shy, jnp.int32),
-                  jax.ShapeDtypeStruct((B,) + shx, f32),
-                  jax.ShapeDtypeStruct((B,) + shy, f32),
-                  jax.ShapeDtypeStruct((B, 2), jnp.int32)]
-    out_specs = [bspec(shx), bspec(shy), bspec(shx), bspec(shy),
-                 bspec(shx), bspec(shy), bspec((2,))]
+    rx, ry = lay.row_x, lay.row_y
+    out_shapes = [jax.ShapeDtypeStruct((Bp, rx), f32),
+                  jax.ShapeDtypeStruct((Bp, ry), f32),
+                  jax.ShapeDtypeStruct((Bp, rx), jnp.int32),
+                  jax.ShapeDtypeStruct((Bp, ry), jnp.int32),
+                  jax.ShapeDtypeStruct((Bp, rx), f32),
+                  jax.ShapeDtypeStruct((Bp, ry), f32),
+                  jax.ShapeDtypeStruct((NB, 2), jnp.int32)]
+    out_specs = [rowspec(rx), rowspec(ry), rowspec(rx), rowspec(ry),
+                 rowspec(rx), rowspec(ry),
+                 pl.BlockSpec((1, 2), lambda b: (b, 0))]
 
-    kern = functools.partial(_crop_sweep_kernel, pg.directional,
-                             NYp1, nsweeps)
+    kern = functools.partial(_crop_sweep_kernel, pg.directional, NYp1,
+                             nsweeps, G, shx, shy, pyx, pyy, geo_meta)
     dx, dy, px, py, wx, wy, stats = pl.pallas_call(
         kern,
-        grid=(B,),
-        in_specs=[bspec(shx), bspec(shy), bspec(shx), bspec(shy),
-                  pl.BlockSpec((1, 1), lambda b: (b, 0)),
-                  bspec(shx), bspec(shy)] + geo_specs + [inc_spec],
+        grid=(NB,),
+        in_specs=[rowspec(rx), rowspec(ry), rowspec(rx), rowspec(ry),
+                  pl.BlockSpec((G, 1), lambda b: (b, 0)),
+                  rowspec(rx), rowspec(ry)] + geo_specs + [inc_spec],
         out_shape=out_shapes,
         out_specs=out_specs,
         interpret=interpret,
-    )(dx0, dy0, ccx, ccy, critb, wx0, wy0, *geo, inc)
+    )(dx0, dy0, ccx, ccy, critb, wx0, wy0, *geo_in, inc)
 
+    def unfold6(a2, shape, pad_y):
+        return unfold_canvas(a2, shape, pad_y)[:B]
+
+    tiles = (unfold6(dx, shx, pyx), unfold6(dy, shy, pyy),
+             unfold6(px, shx, pyx), unfold6(py, shy, pyy),
+             unfold6(wx, shx, pyx), unfold6(wy, shy, pyy))
     bstats = jnp.stack([stats[:, 0].max(), stats[:, 1].max()])
-    return scatter_state(gm_full, fulls, (dx, dy, px, py, wx, wy),
-                         ox, oy) + (bstats,)
+    return scatter_state(gm_full, fulls, tiles, ox, oy) + (bstats,)

@@ -191,6 +191,12 @@ class RouteCheckpoint:
     it_done: int
     pres: float
     driver: dict                  # host scheduling state (widx, wide, ...)
+    # pre-finish legal snapshot (occ, paths, sink_delay, all_reached,
+    # bb, it_done), present iff the wirelength finishing pass was live
+    # when the checkpoint was taken: a resumed run restores it so a
+    # negotiation that already produced a legal route can never end as
+    # a reported failure, exactly like the un-resumed driver
+    fin_save: Optional[tuple] = None
 
 
 @dataclass
@@ -503,7 +509,8 @@ class Router:
                     over_total: int, rerouted: int, relax_steps: int,
                     pres: float, cpd: float, batches: int,
                     relax_useful: Optional[int] = None,
-                    bucket_occ=(), compaction: float = 1.0) -> None:
+                    bucket_occ=(), compaction: float = 1.0,
+                    kernel_plans=()) -> None:
         """Trace + metrics for one committed window: a route.window
         span, K route.iter child spans, and the per-iteration registry
         snapshot.  Iteration boundaries inside a K>1 fused window are
@@ -515,7 +522,11 @@ class Router:
         ``relax_useful`` / ``bucket_occ`` / ``compaction`` feed the
         work-efficiency ledger: sweeps that improved a distance vs.
         total executed, per-dispatch batch-slot occupancy, and the
-        compacted/full plan-width ratio."""
+        compacted/full plan-width ratio.  ``kernel_plans`` (one dict
+        per dispatch, from _plan_block_nets) feeds the
+        hardware-efficiency ledger: a route.kernel span per dispatch
+        plus the route.kernel.* gauges, set from the dispatch covering
+        the most nets (the dominant rung)."""
         tw1 = time.perf_counter()
         useful = relax_steps if relax_useful is None else relax_useful
         tr = get_tracer()
@@ -527,6 +538,9 @@ class Router:
                 relax_steps=relax_steps,
                 relax_steps_useful=int(useful),
                 relax_steps_wasted=int(relax_steps - useful))
+            for kp in kernel_plans:
+                tr.add_complete("route.kernel", tw0, 0.0, cat="route",
+                                **kp)
             dt = (tw1 - tw0) / max(1, K)
             for j in range(K):
                 tr.add_complete("route.iter", tw0 + j * dt, dt,
@@ -545,6 +559,13 @@ class Router:
                 float(occ_frac))
         reg.gauge("route.compaction_ratio").set(round(float(compaction),
                                                       6))
+        if kernel_plans:
+            dom = max(kernel_plans, key=lambda kp: kp.get("nets", 0))
+            reg.set_gauges({
+                "route.kernel.packed_block_size": dom["block_nets"],
+                "route.kernel.lane_occupancy": dom["lane_occupancy"],
+                "route.kernel.bytes_per_sweep": dom["bytes_per_sweep"],
+            })
         reg.counter("route.batches").inc(batches)
         reg.gauge("route.overused_nodes").set(int(n_over))
         reg.gauge("route.overuse_total").set(int(over_total))
@@ -641,6 +662,42 @@ class Router:
             valid_plan[i, :len(b)] = True
         return sel_plan, valid_plan
 
+    def _plan_block_nets(self, tile, nnets: int, nsw: int) -> dict:
+        """Kernel-layout plan for one dispatch (companion of
+        _plan_groups): the SAME VMEM-budget math the packed Pallas
+        wrappers apply (planes_pallas.auto_block_nets), so the
+        route.kernel.* gauges report the block size / occupancy the
+        kernel actually chose for this rung.  For the XLA program the
+        row reports the unpadded one-net-per-step layout instead, with
+        the matching HBM traffic model (~15 canvas traversals/sweep vs
+        the VMEM-resident kernel's one load+store per relaxation)."""
+        from .planes_pallas import (auto_block_nets, packed_layout,
+                                    unpacked_lane_occupancy)
+
+        W, NX, NYp1 = self.pg.shape_x
+        _, NXp1, NY = self.pg.shape_y
+        if tile is not None:
+            cnx, cny = tile
+            shx, shy = (W, cnx, cny + 1), (W, cnx + 1, cny)
+        else:
+            shx, shy = (W, NX, NYp1), (W, NXp1, NY)
+        lay = packed_layout(shx, shy)
+        n = max(1, int(nnets))
+        if self.use_pallas:
+            g = auto_block_nets(shx, shy, n)
+            plan = dict(variant="pallas_packed", block_nets=g,
+                        lane_occupancy=round(lay.lane_occupancy(g), 4),
+                        bytes_per_sweep=int(2 * 6 * 4 * lay.padded_cells
+                                            * n / max(1, nsw)))
+        else:
+            plan = dict(variant="xla", block_nets=1,
+                        lane_occupancy=round(
+                            unpacked_lane_occupancy(shx, shy), 4),
+                        bytes_per_sweep=int(15 * 4 * lay.cells * n))
+        plan.update(tile=(None if tile is None else list(tile)),
+                    nets=n, nsweeps=int(nsw))
+        return plan
+
     # escalating sync schedule: window sizes between host round trips
     # (each device<->host sync costs ~65-70 ms through the tunnel)
     _WINDOWS = (2, 2, 3, 4, 5, 6, 8, 10, 10)
@@ -650,7 +707,7 @@ class Router:
                               paths, sink_delay, all_reached, bb, full_bb,
                               source_d, sinks_d, planes_tbl, nsinks_np,
                               cx_np, cy_np, result, B, mlog,
-                              resume=None):
+                              crop="auto", resume=None):
         """Window-fused PathFinder driver for the planes program: the
         negotiation runs as a sequence of multi-iteration device programs
         (planes.route_window_planes) with ONE host sync per window — the
@@ -714,17 +771,17 @@ class Router:
         # kernel, planes_relax_cropped_pallas); only the spatially
         # sharded mesh path keeps full canvases (crops are net-local)
         crop_forced = None
-        if "x" in opts.crop and self.mesh is None:
-            cwf, chf = (int(v) for v in opts.crop.split("x"))
+        if "x" in crop and self.mesh is None:
+            cwf, chf = (int(v) for v in crop.split("x"))
             crop_forced = (min(cwf, rr.grid.nx - 1),
                            min(chf, rr.grid.ny - 1))
-        elif "x" in opts.crop:
+        elif "x" in crop:
             import warnings
 
             warnings.warn("crop='WxH' is ignored under a mesh (crops "
                           "are net-local; the spatially sharded path "
                           "keeps full canvases)")
-        crop_full = (opts.crop not in ("auto",) and crop_forced is None) \
+        crop_full = (crop not in ("auto",) and crop_forced is None) \
             or self.mesh is not None
 
         if resume is not None:
@@ -749,6 +806,14 @@ class Router:
             force_all_next = d["force_all_next"]
             result.widened_nets = d["widened_nets"]
             crop_full = d.get("crop_full", crop_full)
+            fs = getattr(resume, "fin_save", None)
+            if fs is not None:
+                # re-arm the pre-finish legal snapshot: if the resumed
+                # finishing pass cannot re-legalize within budget, the
+                # legal route is restored instead of reporting failure
+                fin_save = (jnp.asarray(fs[0]), jnp.asarray(fs[1]),
+                            jnp.asarray(fs[2]), jnp.asarray(fs[3]),
+                            jnp.asarray(fs[4]), int(fs[5]))
 
         L = int(paths.shape[2])          # current path-slot budget
         L_cap = self.max_len
@@ -905,6 +970,7 @@ class Router:
                 waves = (max(1, math.ceil(math.log2(maxfan + 1))) + 1
                          if doubling
                          else min(Smax, math.ceil(maxfan / grp_w) + 1))
+                kplan = self._plan_block_nets(tile, len(sub), nsw)
                 out = route_window_planes(
                     self.pg, dev, occ, acc, paths, sink_delay,
                     all_reached, bb, source_d, sinks_d, crit_d,
@@ -924,7 +990,7 @@ class Router:
                 # plan-shape ledger inputs: filled batch slots, plan
                 # width, and real (non-pad) batch rows of this dispatch
                 return out, (int(valid_p.sum()), valid_p.shape[1],
-                             int(valid_p.any(axis=1).sum()))
+                             int(valid_p.any(axis=1).sum())), kplan
 
             t0 = time.time()
             tw0 = time.perf_counter()
@@ -947,11 +1013,13 @@ class Router:
             outs = []
             esc = True
             bucket_occ = []
+            kplans = []
             comp_num = comp_den = 0
             for sub0, tile in dispatch:
-                o, (nvalid, bg, grows) = window_call(sub0, tile, esc,
-                                                     pres)
+                o, (nvalid, bg, grows), kplan = window_call(sub0, tile,
+                                                            esc, pres)
                 esc = False
+                kplans.append(kplan)
                 occ, acc, paths, sink_delay, all_reached, bb = o[:6]
                 crit_d = o[13]
                 outs.append((o, tile))
@@ -1026,7 +1094,8 @@ class Router:
                              len(dirty), w_steps, pres, cpd, int(nexec),
                              relax_useful=w_useful,
                              bucket_occ=bucket_occ,
-                             compaction=comp_num / max(1, comp_den))
+                             compaction=comp_num / max(1, comp_den),
+                             kernel_plans=kplans)
             if analyzer is not None and cpd == cpd:
                 analyzer.crit_path_delay = cpd
             if mlog.enabled:
@@ -1149,6 +1218,16 @@ class Router:
                 a = [np.asarray(v) for v in jax.device_get(
                     (occ, acc, paths, sink_delay, all_reached, bb,
                      crit_d))]
+                fin_ck = None
+                if fin_save is not None:
+                    # the finishing pass is live: the checkpoint must
+                    # carry the pre-finish legal snapshot, or a resumed
+                    # run that fails to re-legalize would report
+                    # success=False after a legal route existed
+                    fin_ck = tuple(
+                        np.asarray(v)
+                        for v in jax.device_get(fin_save[:5])
+                    ) + (int(fin_save[5]),)
                 result.checkpoint = RouteCheckpoint(
                     occ=a[0], acc=a[1], paths=a[2], sink_delay=a[3],
                     all_reached=a[4], bb=a[5], crit=a[6],
@@ -1166,7 +1245,8 @@ class Router:
                         finish_done=finish_done,
                         budget_full=budget_full.copy(),
                         widened_nets=result.widened_nets,
-                        crop_full=crop_full))
+                        crop_full=crop_full),
+                    fin_save=fin_ck)
                 next_ckpt = it_done + opts.checkpoint_every
                 mlog.log("elastic", event="checkpoint",
                          it_done=it_done, pres=round(pres, 4))
@@ -1214,9 +1294,10 @@ class Router:
         if resume is not None and self.pg is None:
             raise ValueError("resume is supported by the planes program")
         opts = self.opts
-        # normalized in place (semantics-preserving) so the planes
-        # driver's opts.crop reads see the canonical form
-        opts.crop = normalize_crop(opts.crop)
+        # normalized into a LOCAL — never mutate the caller's
+        # RouterOpts (the same opts object may drive several routers,
+        # and the caller may compare it against what it passed in)
+        crop = normalize_crop(opts.crop)
         rr, dev = self.rr, self.dev
         R, Smax = term.sinks.shape
         N = rr.num_nodes
@@ -1355,7 +1436,7 @@ class Router:
                     term, crit, timing_cb, analyzer, occ, acc, paths,
                     sink_delay, all_reached, bb, full_bb, source_d,
                     sinks_d, planes_tbl, nsinks_np, cx_np, cy_np,
-                    result, B, mlog, resume=resume)
+                    result, B, mlog, crop=crop, resume=resume)
         if win is not None:
             result.windowed_nets = int((~wide).sum())
         n_over = -1                      # previous iteration's overuse

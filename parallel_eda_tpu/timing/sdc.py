@@ -29,6 +29,7 @@ when read_sdc finds no file).
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -234,6 +235,17 @@ def parse_sdc(text: str) -> SdcConstraints:
                 continue        # setup-only analysis (read_sdc.c flow)
             if n is None or n < 1:
                 raise ValueError("set_multicycle_path needs N >= 1")
+            if frm is not None and frm != to:
+                # the sink-domain STA (module docstring) cannot honor a
+                # source-domain qualifier: say so instead of silently
+                # relaxing every path into the -to domain
+                warnings.warn(
+                    f"set_multicycle_path -from {frm}"
+                    + (f" -to {to}" if to is not None else "")
+                    + ": the -from qualifier is not modeled; the "
+                    "multiplier applies to every path clocked into "
+                    + (f"'{to}'" if to is not None else "any domain")
+                    + " regardless of source clock")
             sdc.multicycles.append((frm, to, n))
         elif cmd == "set_false_path":
             continue            # accepted, not modeled (subset)

@@ -93,7 +93,8 @@ def main():
 
     # ---- 2. end-to-end large route ----
     from parallel_eda_tpu.flow import run_place, run_route, synth_flow
-    from parallel_eda_tpu.obs import compile_seconds, enable_compile_capture
+    from parallel_eda_tpu.obs import (compile_seconds,
+                                      enable_compile_capture, get_metrics)
     from parallel_eda_tpu.place import PlacerOpts
     from parallel_eda_tpu.route import RouterOpts
 
@@ -135,6 +136,13 @@ def main():
               f"{res.total_relax_steps_useful} useful + "
               f"{res.total_relax_steps_wasted} wasted "
               f"({res.total_relax_steps_cropped} in cropped tiles)")
+        kv = get_metrics().values("route.kernel.")
+        if kv.get("route.kernel.packed_block_size") is not None:
+            print(f"- kernel layout: {kv['route.kernel.packed_block_size']} "
+                  f"nets/block, lane occupancy "
+                  f"{kv.get('route.kernel.lane_occupancy')}, "
+                  f"~{kv.get('route.kernel.bytes_per_sweep')} modeled "
+                  f"HBM bytes/sweep (dominant window shape)")
         print(f"- legality: verified by the independent checker (run_route)")
         print(f"- obs: {res.iterations} route iterations, overuse "
               f"trajectory {[s.overused_nodes for s in res.stats]}, "

@@ -72,6 +72,30 @@ def test_elastic_shrink_mesh_to_single():
     assert np.array_equal(res_b.occ, res_m.occ)
 
 
+def test_checkpoint_during_finishing_pass_preserves_success():
+    """A checkpoint taken while the finishing pass is active must carry
+    the pre-finish legal snapshot (fin_save): resuming from it with no
+    iteration budget left must restore that legal solution instead of
+    reporting failure (the hole: finish_done blocked re-triggering but
+    the snapshot wasn't serialized)."""
+    f = _flow()
+    res = Router(f.rr, RouterOpts(batch_size=32,
+                                  checkpoint_every=1)).route(f.term)
+    assert res.success
+    ck = res.checkpoint
+    assert ck is not None
+    # the final checkpoint comes from a finishing-active window
+    assert ck.driver.get("finish_done")
+    assert ck.fin_save is not None
+    # zero remaining budget: the loop body never runs, so success can
+    # only come from the restored fin_save fallback
+    res_b = Router(f.rr, RouterOpts(
+        batch_size=32, max_router_iterations=ck.it_done)).route(
+        f.term, resume=ck)
+    assert res_b.success
+    check_route(f.rr, f.term, res_b.paths, occ=res_b.occ)
+
+
 def test_resume_rejected_for_ell():
     f = _flow()
     r = Router(f.rr, RouterOpts(batch_size=32, checkpoint_every=2,

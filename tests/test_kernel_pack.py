@@ -1,0 +1,168 @@
+"""Lane-packed kernel blocks == one-net-per-step path, bit-for-bit.
+
+The packed kernels (planes_pallas, block of G nets per grid step,
+canvases folded + lane-padded) slice every canvas back to its unpadded
+shape before the shared sweep body runs, so for ANY block size the
+results must equal the legacy layout (block_nets=1, lane_mult=1)
+EXACTLY — same lowering, same shapes inside the body, same fold order.
+Covers odd batch remainders (inert pad nets), directional archs, and
+two crop-ladder rungs.  Interpret mode (no TPU in the test env).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.arch.builtin import minimal_arch, unidir_arch
+from parallel_eda_tpu.route.planes import build_planes
+from parallel_eda_tpu.route.planes_pallas import (
+    VMEM_BUDGET_BYTES, auto_block_nets, packed_layout,
+    planes_relax_cropped_pallas, planes_relax_pallas,
+    unpacked_lane_occupancy)
+from parallel_eda_tpu.rr.graph import CHANX, CHANY, build_rr_graph
+from parallel_eda_tpu.rr.grid import DeviceGrid
+
+
+def _instance(arch, nx, ny, B, seed):
+    grid = DeviceGrid(nx, ny, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    pg = build_planes(rr)
+    N = rr.num_nodes
+    rng = np.random.default_rng(seed)
+    wires = np.where((rr.node_type == CHANX) | (rr.node_type == CHANY))[0]
+    noc = np.asarray(pg.node_of_cell)
+    seed_m = np.zeros((B, N), bool)
+    for b in range(B):
+        seed_m[b, rng.choice(wires, 2, replace=False)] = True
+    cong = rng.uniform(0.5, 2.0, (B, N)).astype(np.float32) * 1e-10
+    d0 = jnp.asarray(np.where(seed_m[:, noc], 0.0, np.inf)
+                     .astype(np.float32))
+    cc = jnp.asarray(cong[:, noc])
+    crit = jnp.asarray(rng.uniform(0, 0.8, (B, 1, 1, 1))
+                       .astype(np.float32))
+    w0 = jnp.zeros((B, pg.ncells), jnp.float32)
+    return rr, pg, d0, cc, crit, w0
+
+
+def _assert_identical(a, b):
+    for x, y in zip(a, b):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.dtype.kind == "f":
+            # bit-identical: equal where finite, inf exactly matched
+            assert np.array_equal(x, y, equal_nan=True), \
+                np.abs(np.where(np.isfinite(x) & np.isfinite(y),
+                                x - y, 0)).max()
+        else:
+            assert np.array_equal(x, y)
+
+
+@pytest.mark.parametrize("arch,nx,ny,B,G,seed", [
+    (minimal_arch(chan_width=6), 4, 4, 5, 4, 0),     # odd remainder
+    (minimal_arch(chan_width=6), 5, 4, 4, 2, 1),
+    (unidir_arch(chan_width=6, length=2), 5, 4, 3, 2, 3),  # directional
+])
+def test_packed_full_matches_one_net_per_step(arch, nx, ny, B, G, seed):
+    _, pg, d0, cc, crit, w0 = _instance(arch, nx, ny, B, seed)
+    ref = planes_relax_pallas(pg, d0, cc, crit, w0, 12, interpret=True,
+                              block_nets=1, lane_mult=1)
+    packed = planes_relax_pallas(pg, d0, cc, crit, w0, 12,
+                                 interpret=True, block_nets=G,
+                                 lane_mult=8)
+    _assert_identical(ref, packed)
+    # the auto-planned default takes the packed path too
+    auto = planes_relax_pallas(pg, d0, cc, crit, w0, 12, interpret=True)
+    _assert_identical(ref, auto)
+
+
+@pytest.mark.parametrize("cnx,cny,G", [(6, 6, 2), (8, 5, 4)])
+def test_packed_cropped_matches_one_net_per_step(cnx, cny, G):
+    """Two crop-ladder rungs (square + rectangular), odd B vs G."""
+    arch = minimal_arch(chan_width=8)
+    grid = DeviceGrid(12, 10, arch.io_capacity)
+    rr = build_rr_graph(arch, grid)
+    pg = build_planes(rr)
+    N = rr.num_nodes
+    B = 3
+    rng = np.random.default_rng(7)
+    noc = np.asarray(pg.node_of_cell)
+    W, NX, NYp1 = pg.shape_x
+    _, _, NY = pg.shape_y
+    ox = rng.integers(0, NX - cnx, B).astype(np.int32)
+    oy = rng.integers(0, NY - cny, B).astype(np.int32)
+    Lm = pg.max_span
+    inside = np.zeros((B, N), bool)
+    for b in range(B):
+        x0, y0 = int(ox[b]) + Lm, int(oy[b]) + Lm
+        x1, y1 = int(ox[b]) + cnx - Lm, int(oy[b]) + cny - Lm
+        inside[b] = ((rr.xlow >= x0) & (rr.xhigh <= x1)
+                     & (rr.ylow >= y0) & (rr.yhigh <= y1)
+                     & ((rr.node_type == CHANX)
+                        | (rr.node_type == CHANY)))
+        assert inside[b].any()
+    cong = rng.uniform(0.5, 2.0, (B, N)).astype(np.float32) * 1e-10
+    cc_n = np.where(inside, cong, np.inf).astype(np.float32)
+    cc = jnp.asarray(cc_n[:, noc])
+    d0n = np.full((B, pg.ncells), np.inf, np.float32)
+    for b in range(B):
+        fin = np.where(np.isfinite(cc_n[b, noc]))[0]
+        d0n[b, rng.choice(fin, 2, replace=False)] = 0.0
+    d0 = jnp.asarray(d0n)
+    crit = jnp.asarray(rng.uniform(0, 0.8, (B, 1, 1, 1))
+                       .astype(np.float32))
+    w0 = jnp.zeros((B, pg.ncells), jnp.float32)
+    oxj, oyj = jnp.asarray(ox), jnp.asarray(oy)
+
+    ref = planes_relax_cropped_pallas(pg, d0, cc, crit, w0, 24, oxj,
+                                      oyj, cnx, cny, interpret=True,
+                                      block_nets=1, lane_mult=1)
+    packed = planes_relax_cropped_pallas(pg, d0, cc, crit, w0, 24, oxj,
+                                         oyj, cnx, cny, interpret=True,
+                                         block_nets=G, lane_mult=8)
+    _assert_identical(ref, packed)
+
+
+@pytest.mark.kernelbench
+def test_kernel_bench_quick_check(tmp_path):
+    """tools/kernel_bench.py --quick writes a ledger that its own
+    --check validator accepts — including the >= 50% lane-occupancy
+    floor on every packed-variant row."""
+    import importlib.util
+    from pathlib import Path
+
+    tool = Path(__file__).resolve().parent.parent / "tools" / \
+        "kernel_bench.py"
+    spec = importlib.util.spec_from_file_location("kernel_bench", tool)
+    kb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(kb)
+
+    out = tmp_path / "kernel_ledger.json"
+    assert kb.main(["--quick", "--out", str(out)]) == 0
+    assert kb.main(["--check", str(out)]) == 0
+    import json
+    doc = json.loads(out.read_text())
+    packed = [r for r in doc["rows"]
+              if r["variant"].startswith("pallas_packed")]
+    assert packed and all(r["lane_occupancy"] >= 0.5 for r in packed)
+    assert all(r["bytes_per_sweep"] > 0 for r in doc["rows"])
+    # a corrupted ledger must fail the gate
+    doc["rows"][0].pop("roofline_fraction")
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    assert kb.main(["--check", str(bad)]) != 0
+
+
+def test_block_planning_model():
+    """auto_block_nets fits the budget, never exceeds the batch, and
+    the packed layout's occupancy model beats the one-net layout at
+    the bench canvas size (the whole point of the fold)."""
+    shx, shy = (12, 12, 13), (12, 13, 12)
+    lay = packed_layout(shx, shy, 8)
+    G = auto_block_nets(shx, shy, 64, 8)
+    assert G >= 8 and G & (G - 1) == 0
+    assert lay.block_bytes(G) <= VMEM_BUDGET_BYTES
+    assert auto_block_nets(shx, shy, 5, 8) <= 5
+    assert lay.lane_occupancy(8) >= 0.5
+    assert lay.lane_occupancy(8) > 4 * unpacked_lane_occupancy(shx, shy)
+    # a rung too big for even one net still runs: G degrades to 1
+    huge = (64, 512, 513)
+    assert auto_block_nets(huge, (64, 513, 512), 64, 8) == 1

@@ -160,3 +160,44 @@ def test_xml_cluster_with_modes_builds_tree(tmp_path):
     assert arch.pb_tree is not None
     assert arch.pb_tree.modes[0].children[0].name == "ble"
     assert arch.K == 6 and arch.I == 20 and arch.N == 8
+
+
+def test_xml_mode_tree_failure_handling(tmp_path, monkeypatch):
+    """The multi-mode pb_tree fallback is for spec gaps (ValueError /
+    KeyError -> warn + flat crossbar), NOT a blanket net: a genuine
+    parser bug (any other exception) must propagate."""
+    import pytest
+
+    import parallel_eda_tpu.pack.pb_type as pb_type_mod
+    from parallel_eda_tpu.arch.xml_parser import read_arch_xml
+
+    frac = _FRAC_PB_XML.format(I=20, O=8, N=4, NM1=3)
+    xml = f"""<architecture>
+      <complexblocklist>
+        <pb_type name="io" capacity="4"/>
+        {frac}
+      </complexblocklist>
+      <device><fc default_in_type="frac" default_in_val="0.5"
+                  default_out_type="frac" default_out_val="0.4"/></device>
+      <segmentlist>
+        <segment name="l1" length="1" freq="1" type="bidir">
+        </segment>
+      </segmentlist>
+    </architecture>"""
+    p = tmp_path / "frac.xml"
+    p.write_text(xml)
+
+    def unsupported(_pb):
+        raise ValueError("unsupported pb structure")
+
+    monkeypatch.setattr(pb_type_mod, "parse_pb_type", unsupported)
+    with pytest.warns(UserWarning, match="flat crossbar"):
+        arch = read_arch_xml(str(p))
+    assert arch.pb_tree is None          # graceful flat fallback
+
+    def buggy(_pb):
+        raise TypeError("parser bug")
+
+    monkeypatch.setattr(pb_type_mod, "parse_pb_type", buggy)
+    with pytest.raises(TypeError, match="parser bug"):
+        read_arch_xml(str(p))

@@ -129,6 +129,29 @@ def test_parse_sdc_io_and_multicycle():
     assert sdc.multicycle_for("other") == 1
 
 
+def test_sdc_multicycle_from_mismatch_warns():
+    """-from with a different (or absent) -to clock is not modeled by
+    the sink-domain STA: the parser must say so instead of silently
+    relaxing every path into the -to domain."""
+    import warnings
+
+    import pytest
+
+    with pytest.warns(UserWarning, match="-from qualifier is not modeled"):
+        sdc = parse_sdc("set_multicycle_path -setup -from clk_a "
+                        "-to clk_b 2\n")
+    assert sdc.multicycles == [("clk_a", "clk_b", 2)]
+    # -from without -to applies to any sink domain: also approximate
+    with pytest.warns(UserWarning, match="any domain"):
+        parse_sdc("set_multicycle_path -setup -from clk_a 2\n")
+    # matched -from/-to and plain -to forms are exactly modeled: silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        sdc = parse_sdc("set_multicycle_path -setup -from clk -to clk 2\n"
+                        "set_multicycle_path -setup -to clk 3\n")
+    assert sdc.multicycle_for("clk") == 3
+
+
 def test_sdc_multicycle_and_io_delays_in_sta():
     from parallel_eda_tpu.timing import TimingAnalyzer
 

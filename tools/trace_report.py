@@ -130,6 +130,24 @@ def summarize(doc) -> str:
                      f"{w_use} useful + {w_was} wasted "
                      f"({w_was / w_tot:.1%} wasted)")
 
+    kernels = [e for e in evs if e.get("name") == "route.kernel"]
+    if kernels:
+        occs = [e["args"]["lane_occupancy"] for e in kernels
+                if isinstance(e.get("args", {}).get("lane_occupancy"),
+                              (int, float))]
+        gmax = max((e.get("args", {}).get("block_nets", 0)
+                    for e in kernels), default=0)
+        variants = sorted({e.get("args", {}).get("variant", "?")
+                           for e in kernels})
+        line = (f"kernel layout: {len(kernels)} window plan(s), "
+                f"variants {'/'.join(variants)}, "
+                f"block_nets<= {gmax}")
+        if occs:
+            line += (f", lane occupancy {min(occs):.3f}"
+                     f"..{max(occs):.3f} "
+                     f"(mean {sum(occs) / len(occs):.3f})")
+        lines.append(line)
+
     compile_us = sum(e["dur"] for e in evs
                      if e.get("cat") == "jax.compile")
     total_us = max((e["ts"] + e["dur"] for e in evs), default=0)
