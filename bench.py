@@ -451,6 +451,17 @@ def main():
                     help="RouterOpts.sweep_budget_div override "
                          "(default: the library default; 1 forces the "
                          "full first-try budgets off-setting)")
+    ap.add_argument("--sync", action="store_true",
+                    help="disable the async host-device pipeline "
+                         "(RouterOpts.pipeline=False): drain every "
+                         "dispatch before further host work.  Bit-"
+                         "identical results; used by the parity suite "
+                         "and for isolating pipeline regressions")
+    ap.add_argument("--compile_cache_dir", default=None,
+                    help="persistent XLA compile-cache directory "
+                         "(RouterOpts.compile_cache_dir): a second run "
+                         "deserializes the route window programs "
+                         "instead of recompiling them")
     args = ap.parse_args()
     serial_error = None
     if args.budget_div is None:
@@ -517,14 +528,16 @@ def main():
     # warmup: one full route populates the compile cache for every
     # program variant the negotiation loop can hit; the SAME Router is
     # reused so the device-resident terminal tables are uploaded once
-    router = Router(rr, RouterOpts(batch_size=args.batch,
-                                   program=args.program,
-                                   sweep_budget_div=args.budget_div))
+    router = Router(rr, RouterOpts(
+        batch_size=args.batch, program=args.program,
+        sweep_budget_div=args.budget_div, pipeline=not args.sync,
+        compile_cache_dir=args.compile_cache_dir))
     from parallel_eda_tpu.obs import compile_seconds, get_metrics
     c0 = compile_seconds()
     t0 = time.time()
     res = router.route(term)
-    log(f"device warmup route: {time.time() - t0:.1f}s "
+    warmup_s = time.time() - t0
+    log(f"device warmup route: {warmup_s:.1f}s "
         f"(success={res.success}, iters={res.iterations})")
     c1 = compile_seconds()
 
@@ -538,6 +551,22 @@ def main():
     nets_per_sec = res.total_net_routes / dt
     log(f"device route: {dt:.1f}s, {res.total_net_routes} net routes, "
         f"{nets_per_sec:.1f} nets/s, wirelength {res.wirelength}")
+    # pipeline ledger of the MEASURED route only: the post-warmup
+    # metrics reset cleared the warmup's pipeline gauges and dispatch
+    # counters; the variant cache itself is process-wide on purpose, so
+    # a fully warmed run reports cache_hits and zero compiles
+    pv = get_metrics().values("route.pipeline.")
+    dv = get_metrics().values("route.dispatch.")
+    log(f"pipeline[{'sync' if args.sync else 'async'}]: "
+        f"plan {pv.get('route.pipeline.host_plan_ms_total', 0)}ms "
+        f"exec {pv.get('route.pipeline.device_exec_ms_total', 0)}ms "
+        f"stall {pv.get('route.pipeline.stall_ms_total', 0)}ms "
+        f"overlap {pv.get('route.pipeline.overlap_frac', 0)} "
+        f"(host-work {pv.get('route.pipeline.host_overlap_frac', 0)}), "
+        f"{pv.get('route.pipeline.blocking_syncs', 0)} blocking syncs, "
+        f"{dv.get('route.dispatch.compiles', 0)} compiles / "
+        f"{dv.get('route.dispatch.cache_hits', 0)} variant cache hits, "
+        f"{pv.get('route.pipeline.upload_skips', 0)} upload skips")
 
     # serial CPU baseline: identical problem, full negotiation
     if args.skip_serial:
@@ -672,6 +701,35 @@ def main():
                 "lane_occupancy": mv.get("route.kernel.lane_occupancy"),
                 "bytes_per_sweep": mv.get(
                     "route.kernel.bytes_per_sweep"),
+            },
+            # async-pipeline ledger (route.pipeline.* gauges +
+            # route.dispatch.* counters, measured route only — the
+            # post-warmup reset() cleared the warmup's accumulation):
+            # overlap_frac is the pipeline FILL factor (device-busy
+            # share of the negotiation timeline); host_overlap_frac is
+            # the stricter host-work-overlapped share.  warmup_s is the
+            # cold-path wall time — with --compile_cache_dir set, a
+            # second process run shows it dropping to deserialization
+            # cost
+            "pipeline": {
+                "sync": bool(args.sync),
+                "warmup_s": round(warmup_s, 3),
+                "plan_ms": pv.get("route.pipeline.host_plan_ms_total"),
+                "exec_ms": pv.get(
+                    "route.pipeline.device_exec_ms_total"),
+                "stall_ms": pv.get("route.pipeline.stall_ms_total"),
+                "serial_ms": pv.get(
+                    "route.pipeline.host_serial_ms_total"),
+                "overlap_frac": pv.get("route.pipeline.overlap_frac"),
+                "host_overlap_frac": pv.get(
+                    "route.pipeline.host_overlap_frac"),
+                "blocking_syncs": pv.get(
+                    "route.pipeline.blocking_syncs"),
+                "upload_skips": pv.get("route.pipeline.upload_skips"),
+                "crit_upload_skips": pv.get(
+                    "route.pipeline.crit_upload_skips"),
+                "compiles": dv.get("route.dispatch.compiles"),
+                "cache_hits": dv.get("route.dispatch.cache_hits"),
             },
             # obs rider (obs.metrics / obs.trace): per-iteration
             # overuse trajectory + compile-vs-execute attribution of

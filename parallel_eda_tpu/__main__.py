@@ -93,6 +93,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "chrome://tracing, summarize with "
                    "tools/trace_report.py — the host-side analogue of "
                    "the reference's LTTng tp.h tracepoints)")
+    p.add_argument("--sync", action="store_true",
+                   help="disable the async host-device route pipeline "
+                   "(drain every dispatch before further host work); "
+                   "bit-identical results, used for isolating pipeline "
+                   "issues and by the parity suite")
+    p.add_argument("--compile_cache_dir", default="",
+                   help="persistent XLA compile-cache directory: a "
+                   "second run deserializes the route window programs "
+                   "instead of recompiling them")
     p.add_argument("--no_timing", action="store_true",
                    help="congestion-driven only (NO_TIMING algorithm)")
     p.add_argument("--sdc", default="",
@@ -309,7 +318,9 @@ def _run_flow(args) -> int:
             astar_fac=args.astar_fac,
             batch_size=args.batch_size, sink_group=args.sink_group,
             crop=args.crop, finish_precise=not args.no_finish,
-            stats_dir=args.stats_dir or None)
+            stats_dir=args.stats_dir or None,
+            pipeline=not args.sync,
+            compile_cache_dir=args.compile_cache_dir or None)
         import contextlib
         prof = contextlib.nullcontext()
         if args.profile:

@@ -22,13 +22,15 @@ from typing import Dict, List, Optional
 
 
 class Counter:
-    """Monotone event count (relax steps, net routes, checkpoints)."""
+    """Monotone accumulator (relax steps, net routes, checkpoints —
+    and float quantities like the pipeline's blocked-milliseconds
+    totals; the increment is any numeric)."""
     __slots__ = ("value",)
 
     def __init__(self):
         self.value = 0
 
-    def inc(self, n: int = 1) -> None:
+    def inc(self, n: float = 1) -> None:
         self.value += n
 
 

@@ -89,6 +89,16 @@ class Tracer:
         with self._lock:
             self.events.append(ev)
 
+    def mark(self, name: str, t_begin: float, t_end: float,
+             cat: str = "flow", **args) -> None:
+        """Record a complete event from a measured [t_begin, t_end)
+        perf_counter interval — the async-pipeline span shape, where
+        the end is a captured completion time rather than "now"
+        (add_complete with the duration computed here, so call sites
+        cannot flip the operands)."""
+        self.add_complete(name, t_begin, t_end - t_begin, cat=cat,
+                          **args)
+
     def instant(self, name: str, cat: str = "flow", **args) -> None:
         ev = {"name": name, "ph": "i", "cat": cat, "s": "t",
               "ts": (time.perf_counter() - self.t0) * 1e6,

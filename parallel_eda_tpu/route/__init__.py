@@ -17,4 +17,5 @@ from .check import RouteError, check_route
 from .device_graph import DeviceRRGraph, to_device
 from .planes import PlanesGraph, build_planes
 from .qor import QorRow, qor_compare
-from .router import RouteResult, Router, RouterOpts, RouteStats
+from .router import (RouteResult, Router, RouterOpts, RouteStats,
+                     enable_persistent_compile_cache)

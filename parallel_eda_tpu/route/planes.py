@@ -1562,10 +1562,15 @@ def route_window_planes(
 
     Returns (occ, acc, paths, sink_delay, all_reached, bb, pres,
     rrm [R], colors [R], n_over, over_total, nroutes, nexec, crit_all,
-    dmax_hist, ..., steps_exec, steps_useful) — the last two are the
-    MEASURED relaxation-sweep counters summed over every executed
+    dmax_hist, max_span, dev_wide, live_wh, unreached, steps_exec,
+    steps_useful, status [R], scal [7]) — steps_exec/steps_useful are
+    the MEASURED relaxation-sweep counters summed over every executed
     group/wave of the window (executed trips of the bounded while_loop,
-    and the subset that improved some distance)."""
+    and the subset that improved some distance); ``status``/``scal``
+    repack the per-net mask/color/bb fields and the scalar counters
+    into two small int32 arrays so the pipelined driver can pull the
+    whole window summary with one async copy (unpack_window_status /
+    SCAL_* below)."""
     G = sel_plan.shape[0]
     R, Smax = sinks_all.shape
 
@@ -1662,8 +1667,54 @@ def route_window_planes(
     # (reduced-budget nets that missed a sink retry at full budget
     # before any widening)
     unreached = ~all_reached
+    # packed per-net status word + scalar summary vector: EVERYTHING the
+    # host control loop needs from a window, as two tiny int32 arrays a
+    # single copy_to_host_async can stream while the host keeps working
+    # (the async-pipeline replacement for the 13-array blocking
+    # jax.device_get).  Layout (unpack_window_status is the only
+    # reader): bit0 rrm, bit1 dev_wide, bit2 unreached, bits3-7 color,
+    # bits8-15 live-h bucket, bits16-23 live-w bucket (same 8-tile
+    # buckets as live_wh above).
+    status = (rrm.astype(jnp.int32)
+              | (dev_wide.astype(jnp.int32) << 1)
+              | (unreached.astype(jnp.int32) << 2)
+              | ((colors.astype(jnp.int32) & 0x1F) << 3)
+              | (hb.astype(jnp.int32) << 8)
+              | (wb.astype(jnp.int32) << 16))
+    n_over_s = (over > 0).sum(dtype=jnp.int32)
+    over_tot_s = over.sum(dtype=jnp.int32)
+    scal = jnp.stack([n_over_s, over_tot_s, nroutes, nexec,
+                      max_span.astype(jnp.int32),
+                      s_exec, s_useful]).astype(jnp.int32)
     return (occ, acc, paths, sink_delay, all_reached, bb, pres, rrm,
-            colors, (over > 0).sum(dtype=jnp.int32),
-            over.sum(dtype=jnp.int32), nroutes, nexec, crit_all,
+            colors, n_over_s, over_tot_s, nroutes, nexec, crit_all,
             dmax_hist, max_span, dev_wide, live_wh, unreached,
-            s_exec, s_useful)
+            s_exec, s_useful, status, scal)
+
+
+# indices into the packed ``scal`` summary vector of route_window_planes
+# (one async copy carries every scalar the host control loop consumes)
+SCAL_N_OVER = 0
+SCAL_OVER_TOTAL = 1
+SCAL_NROUTES = 2
+SCAL_NEXEC = 3
+SCAL_MAX_SPAN = 4
+SCAL_S_EXEC = 5
+SCAL_S_USEFUL = 6
+SCAL_LEN = 7
+
+
+def unpack_window_status(status):
+    """Host-side decode of route_window_planes' packed per-net status
+    word (see the packing comment at the end of route_window_planes).
+    Returns (rrm, colors, dev_wide, unreached, live_w, live_h) as numpy
+    arrays — the same values the unpacked outputs 7/8/16/17/18 carry,
+    from ONE [R] int32 fetch instead of five."""
+    s = np.asarray(status)
+    rrm = (s & 1).astype(bool)
+    dev_wide = ((s >> 1) & 1).astype(bool)
+    unreached = ((s >> 2) & 1).astype(bool)
+    colors = ((s >> 3) & 0x1F).astype(np.int32)
+    live_h = (((s >> 8) & 0xFF).astype(np.int64)) * 8
+    live_w = (((s >> 16) & 0xFF).astype(np.int64)) * 8
+    return rrm, colors, dev_wide, unreached, live_w, live_h

@@ -143,6 +143,20 @@ def main():
                   f"{kv.get('route.kernel.lane_occupancy')}, "
                   f"~{kv.get('route.kernel.bytes_per_sweep')} modeled "
                   f"HBM bytes/sweep (dominant window shape)")
+        pv = get_metrics().values("route.pipeline.")
+        dvv = get_metrics().values("route.dispatch.")
+        if pv.get("route.pipeline.overlap_frac") is not None:
+            print(f"- pipeline: overlap "
+                  f"{pv['route.pipeline.overlap_frac']} (host-work "
+                  f"{pv.get('route.pipeline.host_overlap_frac')}), "
+                  f"plan {pv.get('route.pipeline.host_plan_ms_total')} / "
+                  f"exec {pv.get('route.pipeline.device_exec_ms_total')} / "
+                  f"stall {pv.get('route.pipeline.stall_ms_total')} ms, "
+                  f"{pv.get('route.pipeline.blocking_syncs')} blocking "
+                  f"syncs, {dvv.get('route.dispatch.compiles', 0)} "
+                  f"dispatch compiles / "
+                  f"{dvv.get('route.dispatch.cache_hits', 0)} variant "
+                  f"cache hits")
         print(f"- legality: verified by the independent checker (run_route)")
         print(f"- obs: {res.iterations} route iterations, overuse "
               f"trajectory {[s.overused_nodes for s in res.stats]}, "

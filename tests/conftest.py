@@ -22,10 +22,10 @@ import jax  # noqa: E402  (import does not initialize backends)
 
 jax.config.update("jax_platforms", "cpu")
 
-# NO persistent compile cache for the CPU suite: this jax build's
-# XLA:CPU executable (de)serialization is unreliable — cache loads
-# SEGFAULT on machine-feature mismatch ("+prefer-no-gather not
-# supported") and cache writes abort outright.  The suite recompiles
-# every run; only the TPU bench (bench.py) uses the persistent cache.
+# NO persistent compile cache for the CPU suite: XLA:CPU cache loads
+# can SEGFAULT on machine-feature mismatch ("+prefer-no-gather not
+# supported") when a cache dir is reused across hosts.  Same-host reuse
+# works (bench.py / CLI --compile_cache_dir, measured ~30s -> ~11s
+# warmups), but the suite stays cache-free for hermeticity.
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,308 @@
+"""Async host-device negotiation pipeline (router.py windowed driver).
+
+The pipelined driver overlaps host window planning / staged uploads /
+deferred summary bookkeeping with device execution, with lag-0
+semantics: every dispatch is planned from the SAME fully consumed
+summary as the --sync escape hatch, so the two modes must be
+BIT-identical — occ, paths, wirelength, iteration count.  These are the
+parity gates, plus fast unit coverage of the dispatch-variant cache,
+the plan-staging hash-skip, and trace_report's plan/exec overlap
+checker.
+
+    python -m pytest tests/ -m pipeline        (this suite)
+
+The full-flow parity gates carry @pytest.mark.slow like every other
+end-to-end route test; the unit layer runs in the default suite.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.obs import (MetricsRegistry, Tracer, get_metrics,
+                                  set_metrics, set_tracer)
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
+from parallel_eda_tpu.route import router as router_mod
+
+pytestmark = pytest.mark.pipeline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    set_tracer(None)
+    set_metrics(MetricsRegistry())
+    yield
+    set_tracer(None)
+    set_metrics(MetricsRegistry())
+
+
+# ---- unit layer (default suite) ----
+
+def test_pow2_quantization():
+    p = router_mod._pow2_at_least
+    assert [p(1), p(2), p(3), p(8), p(9), p(100)] == [1, 2, 4, 8, 16, 128]
+    # the point of quantizing nsw/waves: nearby window shapes collapse
+    # onto one canonical dispatch signature instead of one jit entry
+    # per exact value
+    assert len({p(v) for v in range(65, 129)}) == 1
+
+
+def test_dispatch_variant_cache_counters():
+    key_a = ("__test_variant__", 64, 8)
+    key_b = ("__test_variant__", 128, 8)
+    try:
+        assert router_mod._note_dispatch_variant(key_a) is True
+        assert router_mod._note_dispatch_variant(key_a) is False
+        assert router_mod._note_dispatch_variant(key_b) is True
+        v = get_metrics().values("route.dispatch.")
+        assert v["route.dispatch.compiles"] == 2
+        assert v["route.dispatch.cache_hits"] == 1
+        # the variant set is module state on purpose (it mirrors the
+        # process-wide jit cache): a metrics reset must NOT forget warm
+        # variants, or post-warmup runs would report phantom compiles
+        get_metrics().reset()
+        assert router_mod._note_dispatch_variant(key_a) is False
+        assert get_metrics().values(
+            "route.dispatch.")["route.dispatch.cache_hits"] == 1
+    finally:
+        router_mod._DISPATCH_VARIANTS.discard(key_a)
+        router_mod._DISPATCH_VARIANTS.discard(key_b)
+
+
+def test_plan_staging_hash_skip():
+    st = router_mod._PlanStaging()
+    a = np.arange(12, dtype=np.int32).reshape(3, 4)
+    d1 = st.put("sel", a)
+    d2 = st.put("sel", a.copy())        # identical content, new object
+    assert d2 is d1                     # upload skipped, slot reused
+    v = get_metrics().values("route.pipeline.")
+    assert v["route.pipeline.upload_skips"] == 1
+    d3 = st.put("sel", a + 1)           # content changed: re-upload
+    assert d3 is not d1
+    assert np.array_equal(np.asarray(d3), a + 1)
+    # same content under a DIFFERENT slot name is its own buffer
+    d4 = st.put("valid", a + 1)
+    assert d4 is not d3
+
+
+def _ev(name, ts, dur, **args):
+    e = {"name": name, "ph": "X", "cat": "route", "ts": ts, "dur": dur,
+         "pid": 1, "tid": 1}
+    if args:
+        e["args"] = args
+    return e
+
+
+def test_trace_check_pipeline_rules():
+    tr = _load_trace_report()
+
+    def doc(evs):
+        return {"traceEvents": sorted(evs, key=lambda e: e["ts"])}
+
+    # pipelined, 2 windows, plan spans inside exec spans: valid
+    good = doc([
+        _ev("route.pipeline.plan", 0, 10, stage="plan", window=1, rung=0),
+        _ev("route.pipeline.exec", 10, 100, window=1, pipelined=True),
+        _ev("route.pipeline.plan", 40, 20, stage="summary", window=1),
+        _ev("route.pipeline.plan", 120, 10, stage="plan", window=1,
+            rung=0),
+        _ev("route.pipeline.exec", 130, 100, window=2, pipelined=True),
+    ])
+    assert tr.check_pipeline(good) == []
+    ov = tr.pipeline_overlap(good)
+    assert ov["pipelined"] and ov["windows"] == 2
+    assert ov["overlap_us"] == pytest.approx(20.0)
+
+    # pipelined, >= 2 windows, ZERO overlap: the pipeline silently
+    # serialized somewhere — must be flagged
+    serialized = doc([
+        _ev("route.pipeline.plan", 0, 10, window=1),
+        _ev("route.pipeline.exec", 10, 100, window=1, pipelined=True),
+        _ev("route.pipeline.plan", 110, 10, window=2),
+        _ev("route.pipeline.exec", 120, 100, window=2, pipelined=True),
+    ])
+    assert tr.check_pipeline(serialized) != []
+
+    # --sync: non-overlapping is the contract ...
+    sync_ok = doc([
+        _ev("route.pipeline.plan", 0, 10, window=1),
+        _ev("route.pipeline.exec", 10, 50, window=1, pipelined=False),
+        _ev("route.pipeline.plan", 60, 10, window=2),
+        _ev("route.pipeline.exec", 70, 50, window=2, pipelined=False),
+    ])
+    assert tr.check_pipeline(sync_ok) == []
+    # ... and any overlap is a broken escape hatch
+    sync_bad = doc([
+        _ev("route.pipeline.plan", 0, 30, window=1),
+        _ev("route.pipeline.exec", 10, 50, window=1, pipelined=False),
+    ])
+    assert tr.check_pipeline(sync_bad) != []
+
+    # a trace without pipeline spans (pack-only flow) is not an error
+    assert tr.check_pipeline(doc([_ev("pack", 0, 10)])) == []
+    # single-window pipelined runs can't overlap (nothing deferred
+    # yet): tolerated
+    assert tr.check_pipeline(doc([
+        _ev("route.pipeline.plan", 0, 10, window=1),
+        _ev("route.pipeline.exec", 10, 50, window=1, pipelined=True),
+    ])) == []
+
+
+# ---- full-flow parity gates (slow, like every end-to-end route) ----
+
+def _route_both_modes(rr, term, **opts):
+    """Route the same problem pipelined and --sync; each mode twice is
+    unnecessary (both drivers are deterministic, covered elsewhere)."""
+    res_p = Router(rr, RouterOpts(pipeline=True, **opts)).route(term)
+    res_s = Router(rr, RouterOpts(pipeline=False, **opts)).route(term)
+    return res_p, res_s
+
+
+def _assert_bit_identical(res_p, res_s):
+    assert res_p.success == res_s.success
+    assert res_p.iterations == res_s.iterations
+    assert res_p.wirelength == res_s.wirelength
+    assert np.array_equal(res_p.occ, res_s.occ)
+    assert np.array_equal(res_p.paths, res_s.paths)
+
+
+@pytest.mark.slow
+def test_parity_bench_arch():
+    """Pipelined vs --sync on the bench config's circuit shape (the
+    60-LUT arch bench.py measures): occ/paths/wirelength/iterations all
+    bit-identical, and the result is legal."""
+    from parallel_eda_tpu.flow import synth_flow
+    f = synth_flow(num_luts=60, num_inputs=12, num_outputs=12,
+                   chan_width=12, seed=11)
+    res_p, res_s = _route_both_modes(f.rr, f.term, batch_size=64)
+    assert res_p.success
+    _assert_bit_identical(res_p, res_s)
+    check_route(f.rr, f.term, res_p.paths, occ=res_p.occ)
+
+
+@pytest.mark.slow
+def test_parity_directional_arch():
+    """Same parity gate on a unidirectional (single-driver) graph —
+    the directed planes masks exercise different window shapes."""
+    from parallel_eda_tpu.arch.builtin import unidir_arch
+    from parallel_eda_tpu.flow import prepare, run_place
+    from parallel_eda_tpu.netlist.generate import generate_circuit
+    arch = unidir_arch(chan_width=14, length=2)
+    nl = generate_circuit(num_luts=40, num_inputs=6, num_outputs=6,
+                          K=arch.K, seed=3)
+    f = prepare(nl, arch, 14, seed=5)
+    f = run_place(f, timing_driven=False)
+    res_p, res_s = _route_both_modes(f.rr, f.term, batch_size=32)
+    assert res_p.success
+    _assert_bit_identical(res_p, res_s)
+    check_route(f.rr, f.term, res_p.paths, occ=res_p.occ)
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_drains_pipeline():
+    """A checkpoint lands at a window boundary AFTER the in-flight
+    window's summary is consumed: the pipelined run's checkpoint equals
+    the --sync run's, and resuming in either mode finishes with
+    bit-identical results."""
+    from parallel_eda_tpu.flow import synth_flow
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=3)
+    res_p, res_s = _route_both_modes(f.rr, f.term, batch_size=32,
+                                     checkpoint_every=2,
+                                     max_router_iterations=4)
+    assert not res_p.success            # interrupted mid-negotiation
+    ck_p, ck_s = res_p.checkpoint, res_s.checkpoint
+    assert ck_p is not None and ck_s is not None
+    assert ck_p.it_done == ck_s.it_done
+    assert np.array_equal(ck_p.occ, ck_s.occ)
+    assert np.array_equal(ck_p.paths, ck_s.paths)
+
+    # resume the pipelined checkpoint in both modes: same final answer
+    fin_p = Router(f.rr, RouterOpts(batch_size=32,
+                                    pipeline=True)).route(
+        f.term, resume=ck_p)
+    fin_s = Router(f.rr, RouterOpts(batch_size=32,
+                                    pipeline=False)).route(
+        f.term, resume=ck_p)
+    assert fin_p.success
+    _assert_bit_identical(fin_p, fin_s)
+    check_route(f.rr, f.term, fin_p.paths, occ=fin_p.occ)
+
+
+@pytest.mark.slow
+def test_trace_spans_overlap_pipelined_only():
+    """The emitted route.pipeline.{plan,exec} spans satisfy the same
+    invariant trace_report --check enforces: plan time overlaps device
+    exec in the pipelined driver, never in --sync.  Also checks the
+    telemetry riders: overlap_frac gauge, blocking-sync and variant
+    counters."""
+    from parallel_eda_tpu.flow import synth_flow
+    tr_mod = _load_trace_report()
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=3)
+
+    def traced(pipeline):
+        set_metrics(MetricsRegistry())
+        tracer = Tracer()
+        set_tracer(tracer)
+        try:
+            res = Router(f.rr, RouterOpts(
+                batch_size=32, pipeline=pipeline)).route(f.term)
+        finally:
+            set_tracer(None)
+        doc = {"traceEvents": sorted(tracer.events,
+                                     key=lambda e: e["ts"])}
+        return res, doc, get_metrics().values("route.")
+
+    res_p, doc_p, mv_p = traced(True)
+    assert res_p.success
+    ov = tr_mod.pipeline_overlap(doc_p)
+    assert ov is not None and ov["pipelined"] and ov["windows"] >= 2
+    assert ov["overlap_us"] > 0.0
+    assert tr_mod.check_pipeline(doc_p) == []
+    assert 0.0 < mv_p["route.pipeline.overlap_frac"] <= 1.0
+    # one blocking point per pipelined window
+    assert mv_p["route.pipeline.blocking_syncs"] == ov["windows"]
+    # earlier routes in this process may have warmed every variant:
+    # compiles + hits together must still cover each keyed dispatch
+    dv = (mv_p.get("route.dispatch.compiles", 0)
+          + mv_p.get("route.dispatch.cache_hits", 0))
+    assert dv >= ov["windows"]          # every dispatch was keyed
+
+    res_s, doc_s, mv_s = traced(False)
+    ov_s = tr_mod.pipeline_overlap(doc_s)
+    assert ov_s is not None and not ov_s["pipelined"]
+    assert ov_s["overlap_us"] == 0.0
+    assert tr_mod.check_pipeline(doc_s) == []
+    assert mv_s["route.pipeline.host_overlap_frac"] == 0.0
+    _assert_bit_identical(res_p, res_s)
+
+
+@pytest.mark.slow
+def test_crit_upload_skipped_when_unchanged():
+    """A timing_cb that returns the same criticalities leaves the
+    device-resident crit buffer alone (route.pipeline.crit_upload_skips
+    counts the saved [R, Smax] uploads)."""
+    from parallel_eda_tpu.flow import synth_flow
+    f = synth_flow(num_luts=30, num_inputs=6, num_outputs=6,
+                   chan_width=12, seed=2)
+    R, S = f.term.sinks.shape
+    const_crit = np.full((R, S), 0.4, dtype=np.float32)
+
+    res = Router(f.rr, RouterOpts(batch_size=32)).route(
+        f.term, crit=const_crit, timing_cb=lambda _res: const_crit)
+    assert res.success
+    v = get_metrics().values("route.pipeline.")
+    assert v.get("route.pipeline.crit_upload_skips", 0) >= 1

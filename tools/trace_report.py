@@ -91,6 +91,98 @@ def _xs(doc):
             if isinstance(e, dict) and e.get("ph") == "X"]
 
 
+def _merged(intervals):
+    """Merge [t0, t1) intervals (any order) into a sorted disjoint set."""
+    out = []
+    for b0, b1 in sorted(intervals):
+        if out and b0 <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b1)
+        else:
+            out.append([b0, b1])
+    return out
+
+
+def _overlap_us(a0, a1, merged):
+    tot = 0.0
+    for b0, b1 in merged:
+        lo, hi = max(a0, b0), min(a1, b1)
+        if hi > lo:
+            tot += hi - lo
+    return tot
+
+
+def pipeline_overlap(doc):
+    """Host-plan vs device-exec overlap of the async negotiation
+    pipeline: how much of the route.pipeline.plan span time (window
+    planning + staged uploads + deferred summary bookkeeping) ran while
+    a route.pipeline.exec span (device window in flight) was open.
+
+    Returns None when the trace has no pipeline spans (pre-pipeline
+    trace, or a flow that never routed)."""
+    evs = _xs(doc)
+    plans = [e for e in evs if e.get("name") == "route.pipeline.plan"]
+    execs = [e for e in evs if e.get("name") == "route.pipeline.exec"]
+    if not plans or not execs:
+        return None
+
+    def span_of(e):
+        return (e["ts"], e["ts"] + e.get("dur", 0.0))
+
+    # one trace can hold BOTH modes (e.g. the placer's delay-lookup
+    # route runs with the default pipelined driver even in a --sync
+    # flow), so the invariants are judged per exec-span mode
+    p_execs = [e for e in execs if e.get("args", {}).get("pipelined")]
+    s_execs = [e for e in execs if not e.get("args", {}).get("pipelined")]
+    p_merged = _merged([span_of(e) for e in p_execs])
+    s_merged = _merged([span_of(e) for e in s_execs])
+    plan_us = sum(e.get("dur", 0.0) for e in plans)
+    ov_p = sum(_overlap_us(*span_of(e), p_merged) for e in plans)
+    ov_s = sum(_overlap_us(*span_of(e), s_merged) for e in plans)
+    # window args are per-route 1-based indices: any pipelined exec
+    # span with window >= 2 proves some route ran >= 2 pipelined
+    # windows (the shape where overlap is structurally possible and
+    # thus required)
+    multi = any((e.get("args", {}).get("window") or 0) >= 2
+                for e in p_execs)
+    windows = {e.get("args", {}).get("window") for e in execs}
+    return {"plan_spans": len(plans), "exec_spans": len(execs),
+            "windows": len(windows), "pipelined": bool(p_execs),
+            "multi_window_pipelined": multi,
+            "plan_us": plan_us, "overlap_us": ov_p + ov_s,
+            "pipelined_overlap_us": ov_p, "sync_overlap_us": ov_s,
+            "overlap_frac": ((ov_p + ov_s) / plan_us) if plan_us
+            else 0.0}
+
+
+def check_pipeline(doc) -> list:
+    """Pipeline-shape invariants for --check (judged per exec-span
+    mode, since one trace can mix both drivers):
+
+    - some route ran >= 2 pipelined windows (a pipelined exec span
+      with window >= 1 exists): plan-span time MUST overlap pipelined
+      exec spans — the whole point of the async pipeline; zero overlap
+      means the driver silently serialized (e.g. a hidden blocking
+      sync).
+    - plan spans must NEVER overlap --sync (pipelined=false) exec
+      spans — the escape hatch drains every dispatch before further
+      host work by construction.
+    """
+    ov = pipeline_overlap(doc)
+    if ov is None:
+        return []
+    errs = []
+    if ov["multi_window_pipelined"] and ov["pipelined_overlap_us"] <= 0.0:
+        errs.append(
+            "pipelined route (>= 2 windows) with ZERO plan/exec "
+            "overlap: the async pipeline is serialized")
+    if ov["sync_overlap_us"] > 0.0:
+        errs.append(
+            f"{ov['sync_overlap_us'] / 1e3:.3f}ms of plan spans overlap "
+            f"--sync exec spans (the escape hatch drains every dispatch "
+            f"before further host work; overlap there means it leaked)")
+    return errs
+
+
 def summarize(doc) -> str:
     evs = _xs(doc)
     lines = []
@@ -148,6 +240,16 @@ def summarize(doc) -> str:
                      f"(mean {sum(occs) / len(occs):.3f})")
         lines.append(line)
 
+    ov = pipeline_overlap(doc)
+    if ov is not None:
+        mode = "async" if ov["pipelined"] else "sync"
+        lines.append(
+            f"pipeline overlap [{mode}]: {ov['overlap_us'] / us:.3f}s "
+            f"of {ov['plan_us'] / us:.3f}s host plan time ran under "
+            f"device exec spans ({ov['overlap_frac']:.1%}; "
+            f"{ov['windows']} windows, {ov['exec_spans']} exec / "
+            f"{ov['plan_spans']} plan spans)")
+
     compile_us = sum(e["dur"] for e in evs
                      if e.get("cat") == "jax.compile")
     total_us = max((e["ts"] + e["dur"] for e in evs), default=0)
@@ -180,7 +282,7 @@ def main(argv=None) -> int:
         print(f"MALFORMED: {e}", file=sys.stderr)
         return 2
 
-    errs = validate(doc)
+    errs = validate(doc) + check_pipeline(doc)
     if args.check:
         if errs:
             print("MALFORMED trace:", file=sys.stderr)
