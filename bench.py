@@ -20,6 +20,7 @@ is its total net-route invocations over its wall time.
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -34,6 +35,71 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+# XLA/LLVM noise the log level does NOT silence: the host-machine-
+# features (SIGILL risk) warning wall — hundreds of +/-feature tokens
+# plus its banner lines — which drowned the useful bench log out of the
+# captured stderr tail (BENCH_r05.json's tail is ALL feature flags).
+_STDERR_NOISE = re.compile(
+    rb"host machine features|SIGILL|cpu_feature_guard|"
+    rb"This TensorFlow binary is optimized|"
+    rb"absl::InitializeLog|"
+    rb"(?:[+-][A-Za-z0-9_.\-]+,){8,}")
+
+
+def install_stderr_filter():
+    """Interpose a line filter on fd 2 so known XLA noise never reaches
+    the real stderr (and therefore never lands in a driver's captured
+    tail).  fd-level on purpose: the warning wall is printed by native
+    code (TSL/LLVM), not through sys.stderr, and subprocesses (the
+    backend probe) inherit the filtered fd too.  Returns the saved
+    real-stderr fd.  BENCH_NO_STDERR_FILTER=1 disables it."""
+    import atexit
+    import threading
+
+    if os.environ.get("BENCH_NO_STDERR_FILTER"):
+        return None
+    r_fd, w_fd = os.pipe()
+    real = os.dup(2)
+    os.dup2(w_fd, 2)
+    os.close(w_fd)
+
+    def pump():
+        buf = b""
+        while True:
+            try:
+                chunk = os.read(r_fd, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            lines = buf.split(b"\n")
+            buf = lines.pop()
+            for ln in lines:
+                if not _STDERR_NOISE.search(ln):
+                    os.write(real, ln + b"\n")
+        if buf and not _STDERR_NOISE.search(buf):
+            os.write(real, buf)
+        os.close(r_fd)
+
+    t = threading.Thread(target=pump, daemon=True,
+                         name="bench-stderr-filter")
+    t.start()
+
+    def restore():
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        # rebinding fd 2 to the real stderr drops the pipe's last
+        # writer: the pump drains what's left and exits
+        os.dup2(real, 2)
+        t.join(timeout=5.0)
+
+    atexit.register(restore)
+    return real
 
 
 def _enable_compile_cache() -> None:
@@ -330,7 +396,8 @@ def place_microbench(args) -> None:
 
     opts = PlacerOpts(moves_per_step=args.moves_per_step, seed=3)
     placer = Placer(pnl, grid, opts)
-    from parallel_eda_tpu.obs import compile_seconds, get_metrics
+    from parallel_eda_tpu.obs import (compile_seconds, get_metrics,
+                                      reset_compile_seconds)
     c0 = compile_seconds()
     # warmup anneal: populates the compile cache for every sa_segment
     # shape (cold remote compiles on the tunneled TPU take minutes and
@@ -338,12 +405,13 @@ def place_microbench(args) -> None:
     t0 = time.time()
     placer.place(flow.pos)
     log(f"device warmup anneal: {time.time() - t0:.1f}s")
-    c1 = compile_seconds()
+    compile_warmup_s = compile_seconds() - c0
     get_metrics().reset()        # the measured anneal's snapshots only
+    reset_compile_seconds()      # steady-state compile attribution
     t0 = time.time()
     pos_d, stats = placer.place(flow.pos)
     ddt = time.time() - t0
-    c2 = compile_seconds()
+    compile_measured_s = compile_seconds()
     dev_mps = stats.total_moves / max(ddt, 1e-9)
     log(f"device anneal: {ddt:.1f}s, {stats.total_moves} moves, "
         f"{dev_mps / 1e6:.3f} M moves/s, final bb cost "
@@ -394,14 +462,15 @@ def place_microbench(args) -> None:
                           .histogram("place.acceptance_rate").mean, 4)
                     if get_metrics()
                     .histogram("place.acceptance_rate").count else None),
-                "compile_s_warmup": round(c1 - c0, 3),
-                "compile_s_measured": round(c2 - c1, 3),
-                "execute_s_measured": round(max(0.0, ddt - (c2 - c1)),
-                                            3),
+                "compile_s_warmup": round(compile_warmup_s, 3),
+                "compile_s_measured": round(compile_measured_s, 3),
+                "execute_s_measured": round(
+                    max(0.0, ddt - compile_measured_s), 3),
             }}})
 
 
 def main():
+    install_stderr_filter()
     ap = argparse.ArgumentParser()
     ap.add_argument("--luts", type=int, default=60)
     ap.add_argument("--chan_width", type=int, default=12)
@@ -506,9 +575,14 @@ def main():
     # compile listener lets the bench split compile from execute time
     # without wrapping any jit call site, and the metrics registry
     # carries the per-iteration trajectories
-    from parallel_eda_tpu.obs import enable_compile_capture, get_metrics
+    from parallel_eda_tpu.obs import (enable_compile_capture,
+                                      get_devprof, get_metrics)
     enable_compile_capture()
     get_metrics().enabled = True
+    # device-truth profiler: notes every dispatch variant (warmup
+    # included — its own seen-set is fresh even on a warm jit cache);
+    # the AOT capture runs after the measured route
+    get_devprof().enabled = True
 
     if args.sweep_only:
         sweep_microbench(args)
@@ -532,22 +606,24 @@ def main():
         batch_size=args.batch, program=args.program,
         sweep_budget_div=args.budget_div, pipeline=not args.sync,
         compile_cache_dir=args.compile_cache_dir))
-    from parallel_eda_tpu.obs import compile_seconds, get_metrics
+    from parallel_eda_tpu.obs import (compile_seconds, get_metrics,
+                                      reset_compile_seconds)
     c0 = compile_seconds()
     t0 = time.time()
     res = router.route(term)
     warmup_s = time.time() - t0
     log(f"device warmup route: {warmup_s:.1f}s "
         f"(success={res.success}, iters={res.iterations})")
-    c1 = compile_seconds()
+    compile_warmup_s = compile_seconds() - c0
 
     get_metrics().reset()        # the measured route's ledger only
-    t0 = time.time()
+    reset_compile_seconds()      # steady-state compile split: the
+    t0 = time.time()             # measured run's compile time alone
     res = router.route(term)
     dt = time.time() - t0
-    c2 = compile_seconds()
-    log(f"compile split: {c1 - c0:.1f}s during warmup, "
-        f"{c2 - c1:.1f}s during the measured route")
+    compile_measured_s = compile_seconds()
+    log(f"compile split: {compile_warmup_s:.1f}s during warmup, "
+        f"{compile_measured_s:.1f}s during the measured route")
     nets_per_sec = res.total_net_routes / dt
     log(f"device route: {dt:.1f}s, {res.total_net_routes} net routes, "
         f"{nets_per_sec:.1f} nets/s, wirelength {res.wirelength}")
@@ -567,6 +643,22 @@ def main():
         f"{dv.get('route.dispatch.compiles', 0)} compiles / "
         f"{dv.get('route.dispatch.cache_hits', 0)} variant cache hits, "
         f"{pv.get('route.pipeline.upload_skips', 0)} upload skips")
+
+    # device-truth cost capture: AOT-relower every dispatch variant the
+    # run noted and read XLA's cost/memory analysis — AFTER dt is
+    # recorded, so the half-compile per variant never lands in the
+    # measured wall time
+    get_devprof().capture_all()
+    devcost = get_devprof().summary()
+    if "unavailable" in devcost:
+        log(f"devcost: unavailable ({devcost['unavailable']})")
+    else:
+        log(f"devcost[{devcost.get('variants')} variants]: dominant "
+            f"{devcost.get('flops', 0):.3g} flops / "
+            f"{devcost.get('bytes_accessed', 0):.3g} B accessed, "
+            f"peak temp {devcost.get('temp_bytes', 0)} B; measured/"
+            f"modeled bytes {devcost.get('bytes_delta')} "
+            f"(band 1e±{devcost.get('delta_band_log10')})")
 
     # serial CPU baseline: identical problem, full negotiation
     if args.skip_serial:
@@ -736,13 +828,20 @@ def main():
             # the measured route (warmup absorbs the cold compiles;
             # any residual measured-run compile means a new program
             # shape was hit mid-negotiation)
+            # device-truth cost rider (route.devcost.*, obs/devprof):
+            # XLA's measured FLOPs/bytes for the dominant dispatch
+            # variant and the measured-vs-modeled bytes delta against
+            # the planner's bytes_per_sweep (or unavailable + reason on
+            # backends without cost analysis)
+            "devcost": devcost,
             "obs": {
                 "route_iterations": int(res.iterations),
                 "overuse_trajectory": [int(s.overused_nodes)
                                        for s in res.stats],
-                "compile_s_warmup": round(c1 - c0, 3),
-                "compile_s_measured": round(c2 - c1, 3),
-                "execute_s_measured": round(max(0.0, dt - (c2 - c1)), 3),
+                "compile_s_warmup": round(compile_warmup_s, 3),
+                "compile_s_measured": round(compile_measured_s, 3),
+                "execute_s_measured": round(
+                    max(0.0, dt - compile_measured_s), 3),
             },
         },
     })

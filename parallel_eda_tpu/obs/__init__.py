@@ -13,7 +13,13 @@ sinks:
   metrics.py  — counters/gauges/histograms snapshotted per iteration
                 (router overuse, relax steps, SA temperature/acceptance,
                 STA crit-path trajectory), dumpable as JSON next to the
-                mdclog sinks
+                mdclog sinks; snapshots also mirror the COUNTER_TRACKS
+                instruments as Perfetto counter ("C") events on the
+                tracer's clock
+  devprof.py  — device-truth cost layer: XLA cost/memory analysis per
+                canonicalized dispatch variant (measured FLOPs/bytes vs
+                the planner's modeled bytes_per_sweep), published as
+                route.devcost.* gauges + a stats_dir/devprof.json ledger
   ../mdclog.py — the existing per-(window, category) structured logs,
                 now sharing the tracer's clock so records line up with
                 span timestamps
@@ -23,14 +29,18 @@ MetricsRegistry.enabled), like the reference's compiled-out log macros
 (log.h:29-33).  See OBSERVABILITY.md at the repo root.
 """
 
-from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      get_metrics, set_metrics)
+from .devprof import DevProfiler, get_devprof, set_devprof
+from .metrics import (COUNTER_TRACKS, Counter, Gauge, Histogram,
+                      MetricsRegistry, get_metrics, set_metrics)
 from .trace import (Tracer, compile_seconds, enable_compile_capture,
-                    get_tracer, set_tracer, span, stage)
+                    get_tracer, reset_compile_seconds, set_tracer,
+                    span, stage)
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "get_metrics", "set_metrics",
+    "COUNTER_TRACKS", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "get_metrics", "set_metrics",
+    "DevProfiler", "get_devprof", "set_devprof",
     "Tracer", "compile_seconds", "enable_compile_capture",
-    "get_tracer", "set_tracer", "span", "stage",
+    "get_tracer", "reset_compile_seconds", "set_tracer", "span",
+    "stage",
 ]

@@ -20,6 +20,17 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional
 
+from .trace import get_tracer
+
+# instruments mirrored as Perfetto counter-track ("C") samples on every
+# snapshot: the trajectories worth seeing as stepped tracks aligned with
+# the spans (negotiation convergence, schedule pressure, waste, stalls,
+# SA temperature).  Mirroring happens inside snapshot() — same clock
+# origin as the spans, no extra call sites to keep in step.
+COUNTER_TRACKS = ("route.overused_nodes", "route.pres_fac",
+                  "route.relax_steps_wasted",
+                  "route.pipeline.stall_ms", "place.t")
+
 
 class Counter:
     """Monotone accumulator (relax steps, net routes, checkpoints —
@@ -130,6 +141,13 @@ class MetricsRegistry:
             return None
         snap = {"labels": labels, "values": self.values()}
         self.snapshots.append(snap)
+        tr = get_tracer()
+        if tr is not None:
+            for name in COUNTER_TRACKS:
+                v = snap["values"].get(name)
+                if isinstance(v, (int, float)) and not isinstance(v,
+                                                                  bool):
+                    tr.counter(name, v)
         return snap
 
     def series(self, name: str, **match) -> list:

@@ -108,6 +108,18 @@ class Tracer:
         with self._lock:
             self.events.append(ev)
 
+    def counter(self, name: str, value, cat: str = "metrics") -> None:
+        """Record one sample of a Perfetto counter track ("C" event)
+        on the span clock origin, so trajectories (overuse, pres_fac,
+        stall time, SA temperature) render as stepped tracks aligned
+        with the spans of the same run."""
+        ev = {"name": name, "ph": "C", "cat": cat,
+              "ts": (time.perf_counter() - self.t0) * 1e6,
+              "pid": 1, "tid": threading.get_ident() & 0x7FFFFFFF,
+              "args": {"value": float(value)}}
+        with self._lock:
+            self.events.append(ev)
+
     def total(self, name_prefix: str) -> float:
         """Sum of span durations (seconds) whose name starts with
         name_prefix — e.g. total("jax.compile") for the compile split."""
@@ -227,5 +239,15 @@ def enable_compile_capture() -> None:
 
 def compile_seconds() -> float:
     """Total JAX compile-phase seconds observed since capture was
-    enabled (monotone; diff around a region to attribute it)."""
+    enabled (monotone between resets; diff around a region to
+    attribute it)."""
     return _compile_s
+
+
+def reset_compile_seconds() -> None:
+    """Zero the compile-seconds accumulator.  The benches call this at
+    the warmup/measured boundary (alongside MetricsRegistry.reset) so
+    a steady-state row's compile split is the measured run's compile
+    time alone, never the warmup's folded in."""
+    global _compile_s
+    _compile_s = 0.0

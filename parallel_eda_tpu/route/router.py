@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..obs import get_metrics, get_tracer
+from ..obs import get_devprof, get_metrics, get_tracer
 from ..rr.graph import RRGraph
 from ..rr.terminals import NetTerminals
 from .device_graph import DeviceRRGraph, to_device
@@ -502,6 +502,24 @@ def _note_dispatch_variant(key) -> bool:
     return True
 
 
+# how many overused rr-node ids each window's congestion record lists
+_CONGESTION_TOPK = 8
+
+
+def _top_overused(occ, capacity, k: int = _CONGESTION_TOPK) -> list:
+    """Top-k overused rr-node ids for the mdclog congestion record:
+    [[node_id, overuse], ...] sorted by overuse descending, only nodes
+    with occ > capacity.  The reference dumped per-node congestion into
+    its stats files; this is the spatial-telemetry seed for heatmaps."""
+    over = np.asarray(occ).astype(np.int64) - np.asarray(capacity)
+    k = min(int(k), over.size)
+    if k <= 0:
+        return []
+    idx = np.argpartition(over, -k)[-k:]
+    idx = idx[np.argsort(-over[idx], kind="stable")]
+    return [[int(i), int(over[i])] for i in idx if over[i] > 0]
+
+
 class _PlanStaging:
     """Named device staging slots for the per-rung plan tensors
     (sel/valid/widen masks).  put() hash-skips the upload when the slot
@@ -737,7 +755,11 @@ class Router:
             mlog.log("congestion", overused_nodes=bk["n_over"],
                      overuse_total=bk["over_total"],
                      pres_fac=round(bk["pres"], 4),
-                     widened=bk["widened"])
+                     widened=bk["widened"],
+                     top_overused=(
+                         _top_overused(bk["occ_ref"],
+                                       self.dev.capacity)
+                         if bk.get("occ_ref") is not None else []))
             mlog.log("schedule", colors=bk["colors_max"],
                      dirty_next=bk["dirty_next"],
                      precise=bk["precise"],
@@ -1206,7 +1228,7 @@ class Router:
                      sel_p.shape[0], sel_p.shape[1], wok is None,
                      self.use_pallas, self.mesh is not None,
                      bool(sta_kw), R, Smax, N))
-                out = route_window_planes(
+                wp_args = (
                     self.pg, dev, occ, acc, paths, sink_delay,
                     all_reached, bb, source_d, sinks_d, crit_d,
                     *planes_tbl,
@@ -1219,9 +1241,17 @@ class Router:
                     jnp.int32(it_done + 1 if force_all_next
                               else opts.incremental_after),
                     K, nsw, L, waves, grp_w,
-                    doubling, min(4096, N), 5, self.mesh,
-                    use_pallas=self.use_pallas, crop_tile=tile,
-                    bb0_all=bb0_d, widen_ok=wok, **sta_kw)
+                    doubling, min(4096, N), 5, self.mesh)
+                wp_kwargs = dict(use_pallas=self.use_pallas,
+                                 crop_tile=tile, bb0_all=bb0_d,
+                                 widen_ok=wok, **sta_kw)
+                # device-truth profiling: avatarize the REAL call args
+                # BEFORE the dispatch donates them, so capture_all()
+                # can AOT-relower this exact variant later
+                get_devprof().note_variant(
+                    (tile, K, nsw, L, waves, grp_w), kplan,
+                    route_window_planes, wp_args, wp_kwargs)
+                out = route_window_planes(*wp_args, **wp_kwargs)
                 # plan-shape ledger inputs: filled batch slots, plan
                 # width, and real (non-pad) batch rows of this dispatch
                 return out, (int(valid_p.sum()), valid_p.shape[1],
@@ -1415,7 +1445,12 @@ class Router:
                                else 0),
                 dirty_next=int(rrm.sum()), precise=precise,
                 sweep_boost=sweep_boost, widened=result.widened_nets,
-                dmax_hist=dmax_hist)
+                dmax_hist=dmax_hist,
+                # occ snapshot for the congestion top-k: only in mdclog
+                # runs, which force the synchronous driver — there the
+                # record is booked inline, before the next dispatch
+                # donates this array
+                occ_ref=(occ if mlog.enabled else None))
             if analyzer is not None and cpd == cpd:
                 analyzer.crit_path_delay = cpd
             if not pipelined:
@@ -1598,6 +1633,13 @@ class Router:
             write_route_report(
                 os.path.join(opts.stats_dir, "route_report.txt"),
                 rr, result.occ, R)
+            dp = get_devprof()
+            if dp.enabled:
+                # device-truth ledger: AOT lower+compile each noted
+                # variant (outside every timed window) and dump next
+                # to metrics.json / the mdclog files
+                dp.capture_all()
+                dp.dump(os.path.join(opts.stats_dir, "devprof.json"))
         return result
 
     def route(self, term: NetTerminals,
@@ -1757,6 +1799,10 @@ class Router:
             # values directly comparable with span timestamps
             from ..mdclog import MdcLogger
             tr = get_tracer()
+            if opts.stats_dir:
+                # a stats_dir run is the diagnostics mode: the device-
+                # truth profiler rides along and dumps devprof.json
+                get_devprof().enabled = True
             with MdcLogger(opts.stats_dir,
                            t0=tr.t0 if tr is not None else None) as mlog:
                 return self._route_planes_windows(

@@ -94,7 +94,8 @@ def main():
     # ---- 2. end-to-end large route ----
     from parallel_eda_tpu.flow import run_place, run_route, synth_flow
     from parallel_eda_tpu.obs import (compile_seconds,
-                                      enable_compile_capture, get_metrics)
+                                      enable_compile_capture, get_devprof,
+                                      get_metrics)
     from parallel_eda_tpu.place import PlacerOpts
     from parallel_eda_tpu.route import RouterOpts
 
@@ -116,6 +117,7 @@ def main():
         f = run_place(f, PlacerOpts(moves_per_step=256), timing_driven=False)
         t_place = time.time() - t0
         log(f"placed in {t_place:.0f}s")
+        get_devprof().enabled = True
         c0 = compile_seconds()
         t0 = time.time()
         f = run_route(f, RouterOpts(batch_size=args.batch),
@@ -157,6 +159,18 @@ def main():
                   f"dispatch compiles / "
                   f"{dvv.get('route.dispatch.cache_hits', 0)} variant "
                   f"cache hits")
+        get_devprof().capture_all()
+        dc = get_devprof().summary()
+        if "unavailable" in dc:
+            print(f"- devcost: unavailable ({dc['unavailable']})")
+        else:
+            print(f"- devcost: {dc.get('measured_variants')}/"
+                  f"{dc.get('variants')} variants measured, dominant "
+                  f"{dc.get('flops', 0):.3g} flops / "
+                  f"{dc.get('bytes_accessed', 0):.3g} B accessed, "
+                  f"peak temp {dc.get('temp_bytes', 0)} B, "
+                  f"measured/modeled bytes {dc.get('bytes_delta')} "
+                  f"(band 1e±{dc.get('delta_band_log10')})")
         print(f"- legality: verified by the independent checker (run_route)")
         print(f"- obs: {res.iterations} route iterations, overuse "
               f"trajectory {[s.overused_nodes for s in res.stats]}, "

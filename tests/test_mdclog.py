@@ -71,3 +71,30 @@ def test_unknown_category_rejected(tmp_path):
     with pytest.raises(ValueError):
         log.log("nonsense", x=1)
     assert set(CATEGORIES) >= {"route", "congestion", "timing"}
+
+
+def test_top_overused_spatial_telemetry(tmp_path):
+    """The congestion category's top-k overused rr-node list: sorted by
+    overuse descending, only genuinely overused nodes, JSON-clean
+    through the logger."""
+    import numpy as np
+
+    from parallel_eda_tpu.route.router import _top_overused
+
+    occ = np.array([0, 5, 2, 9, 1, 3], dtype=np.int32)
+    cap = np.array([1, 2, 2, 4, 1, 1], dtype=np.int32)
+    top = _top_overused(occ, cap, k=4)
+    # node 3 over by 5, node 1 over by 3, node 5 over by 2; nodes at or
+    # under capacity never appear
+    assert top == [[3, 5], [1, 3], [5, 2]]
+    assert _top_overused(occ, cap, k=2) == [[3, 5], [1, 3]]
+    assert _top_overused(cap, cap) == []          # nothing overused
+    assert _top_overused(occ, cap, k=0) == []
+
+    # round-trips through the congestion log as plain JSON
+    with MdcLogger(str(tmp_path)) as log:
+        log.set_mdc(window=1)
+        log.log("congestion", overused_nodes=3, top_overused=top)
+    p = tmp_path / "logs" / "window_1" / "congestion.log"
+    rec = json.loads(p.read_text().strip())
+    assert rec["top_overused"] == [[3, 5], [1, 3], [5, 2]]

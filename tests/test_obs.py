@@ -10,8 +10,9 @@ import sys
 
 import pytest
 
-from parallel_eda_tpu.obs import (MetricsRegistry, Tracer, get_metrics,
-                                  set_metrics, set_tracer, span, stage)
+from parallel_eda_tpu.obs import (DevProfiler, MetricsRegistry, Tracer,
+                                  get_metrics, set_devprof, set_metrics,
+                                  set_tracer, span, stage)
 from parallel_eda_tpu.obs.trace import _NULL_SPAN
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -29,12 +30,14 @@ def _load_trace_report():
 @pytest.fixture(autouse=True)
 def _clean_obs():
     """Each test gets (and leaves behind) pristine process-wide obs
-    state: no tracer, a fresh disabled registry."""
+    state: no tracer, a fresh disabled registry + devprof."""
     set_tracer(None)
     set_metrics(MetricsRegistry())
+    set_devprof(DevProfiler())
     yield
     set_tracer(None)
     set_metrics(MetricsRegistry())
+    set_devprof(DevProfiler())
 
 
 # ---- tracer ----
@@ -141,6 +144,97 @@ def test_metrics_reset_keeps_enabled():
     assert reg.enabled and reg.values() == {} and reg.snapshots == []
 
 
+def test_series_ordering_and_labels_across_reset():
+    """series() preserves snapshot order, honors label matching, and a
+    reset() (the benches' warmup/measured boundary) starts the history
+    over instead of splicing old samples in."""
+    reg = MetricsRegistry(enabled=True)
+    reg.gauge("g").set(1)
+    reg.snapshot(phase="route", iteration=1)
+    reg.gauge("g").set(2)
+    reg.snapshot(phase="route", iteration=2)
+    reg.gauge("g").set(9)
+    reg.snapshot(phase="place", temperature=0)
+    assert reg.series("g", phase="route") == [1, 2]
+    assert reg.series("g") == [1, 2, 9]
+    assert reg.series("g", phase="route", iteration=2) == [2]
+    assert reg.series("g", phase="sta") == []
+    reg.reset()
+    assert reg.series("g", phase="route") == []
+    reg.gauge("g").set(7)
+    reg.snapshot(phase="route", iteration=1)
+    assert reg.series("g", phase="route") == [7]
+
+
+def test_dispatch_variant_set_survives_registry_reset():
+    """The warmup/measured boundary resets the registry but must NOT
+    forget which dispatch variants already compiled: the measured run's
+    route.dispatch.* split would otherwise count warm cache hits as
+    fresh compiles."""
+    from parallel_eda_tpu.route import router as rt
+
+    key = ("test-only-variant", 1, 2, 3)
+    rt._DISPATCH_VARIANTS.discard(key)
+    try:
+        reg = get_metrics()
+        assert rt._note_dispatch_variant(key) is True
+        assert reg.counter("route.dispatch.compiles").value == 1
+        reg.reset()                       # warmup/measured boundary
+        assert rt._note_dispatch_variant(key) is False
+        assert reg.counter("route.dispatch.cache_hits").value == 1
+        assert reg.counter("route.dispatch.compiles").value == 0
+    finally:
+        rt._DISPATCH_VARIANTS.discard(key)
+
+
+# ---- Perfetto counter tracks ----
+
+def test_snapshot_mirrors_counter_tracks(tmp_path):
+    """Every enabled snapshot mirrors the COUNTER_TRACKS instruments as
+    "C" events on the tracer's clock; other instruments (and bools) do
+    not leak onto tracks."""
+    tr = Tracer()
+    set_tracer(tr)
+    reg = MetricsRegistry(enabled=True)
+    set_metrics(reg)
+    with tr.span("route", cat="stage"):
+        reg.gauge("route.overused_nodes").set(25)
+        reg.gauge("route.pres_fac").set(0.5)
+        reg.counter("route.relax_steps_wasted").inc(4)
+        reg.gauge("route.success").set(True)      # not a track
+        reg.snapshot(phase="route", iteration=1)
+        reg.gauge("route.overused_nodes").set(9)
+        reg.gauge("route.pres_fac").set(0.65)
+        reg.counter("route.relax_steps_wasted").inc(3)
+        reg.snapshot(phase="route", iteration=2)
+    cs = [e for e in tr.events if e["ph"] == "C"]
+    assert {e["name"] for e in cs} == {"route.overused_nodes",
+                                       "route.pres_fac",
+                                       "route.relax_steps_wasted"}
+    by = {}
+    for e in cs:
+        by.setdefault(e["name"], []).append(e["args"]["value"])
+    assert by["route.overused_nodes"] == [25.0, 9.0]
+    assert by["route.relax_steps_wasted"] == [4.0, 7.0]
+    # the export round-trips through --check (incl. counter rules) and
+    # the summary prints the counter-track line
+    p = tmp_path / "t.json"
+    tr.export(str(p))
+    mod = _load_trace_report()
+    doc = json.loads(p.read_text())
+    assert mod.validate(doc) == []
+    assert mod.check_counters(doc) == []
+    s = mod.summarize(doc)
+    assert "counter tracks:" in s and "route.overused_nodes" in s
+
+
+def test_snapshot_counter_mirror_without_tracer():
+    """No tracer installed: snapshots still record, nothing crashes."""
+    reg = MetricsRegistry(enabled=True)
+    reg.gauge("route.pres_fac").set(0.5)
+    assert reg.snapshot(phase="route") is not None
+
+
 # ---- JAX compile capture ----
 
 def test_compile_spans_captured():
@@ -211,6 +305,91 @@ def test_trace_report_check_rejects_malformed(tmp_path):
     r = subprocess.run([sys.executable, TRACE_REPORT, str(notjson),
                        "--check"], capture_output=True, text=True)
     assert r.returncode == 2
+
+
+def test_trace_report_counter_rules(tmp_path):
+    """check_counters rejects samples off the span clock origin,
+    non-numeric values, and non-monotone per-track timestamps."""
+    mod = _load_trace_report()
+    x = {"ph": "X", "name": "route", "cat": "stage", "ts": 0,
+         "dur": 100, "pid": 1, "tid": 1}
+
+    def c(name, ts, value):
+        return {"ph": "C", "name": name, "cat": "metrics", "ts": ts,
+                "pid": 1, "tid": 1, "args": {"value": value}}
+
+    # a counter stamped from a different clock origin lands far outside
+    # the [0, span end + slack] envelope
+    doc = {"traceEvents": [x, c("route.pres_fac", 1e9, 1.0)]}
+    errs = mod.check_counters(doc)
+    assert errs and "clock" in errs[0]
+    # non-numeric / boolean values
+    doc = {"traceEvents": [x, c("route.pres_fac", 5, "high")]}
+    assert any("non-numeric" in e for e in mod.check_counters(doc))
+    doc = {"traceEvents": [x, c("route.pres_fac", 5, True)]}
+    assert any("non-numeric" in e for e in mod.check_counters(doc))
+    # per-track ts must be non-decreasing
+    doc = {"traceEvents": [x, c("route.pres_fac", 50, 1.0),
+                           c("route.pres_fac", 10, 2.0)]}
+    assert any("monotone" in e for e in mod.check_counters(doc))
+    # a clean track passes, and the CLI --check gates the bad one
+    doc = {"traceEvents": [x, c("route.pres_fac", 10, 1.0),
+                           c("route.pres_fac", 50, 2.0)]}
+    assert mod.check_counters(doc) == []
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"traceEvents": [x, c("route.pres_fac", 1e9, 1.0)]}))
+    r = subprocess.run([sys.executable, TRACE_REPORT, str(bad),
+                       "--check"], capture_output=True, text=True)
+    assert r.returncode == 1 and "clock" in r.stderr
+
+
+def test_reset_compile_seconds():
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_eda_tpu.obs import (compile_seconds,
+                                      enable_compile_capture,
+                                      reset_compile_seconds)
+
+    enable_compile_capture()
+    jax.jit(lambda x: x - 1.5)(jnp.ones((3,))).block_until_ready()
+    assert compile_seconds() > 0.0
+    reset_compile_seconds()
+    assert compile_seconds() == 0.0
+    # and the accumulator keeps counting after the reset
+    jax.jit(lambda x: x * 0.5 - 2.0)(jnp.ones((4,))).block_until_ready()
+    assert compile_seconds() > 0.0
+
+
+# ---- bench stderr noise filter ----
+
+def test_bench_stderr_filter_scrubs_noise():
+    """The fd-level filter drops the XLA host-machine-features warning
+    wall (printed by native code, so it must be caught at fd 2, not
+    sys.stderr) while passing ordinary lines through."""
+    code = "\n".join([
+        "import os, sys",
+        f"sys.path.insert(0, {REPO!r})",
+        "import bench",
+        "bench.install_stderr_filter()",
+        "os.write(2, b'keep this line\\n')",
+        "os.write(2, b'... SIGILL ... host machine features ...\\n')",
+        "os.write(2, b'+sse4a,-avx512vnni,+cmov,-amx,+avx,+avx2,"
+        "-foo,+bar,+baz\\n')",
+        "os.write(2, b'also keep\\n')",
+    ])
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=60)
+    assert "keep this line" in r.stderr and "also keep" in r.stderr
+    assert "SIGILL" not in r.stderr
+    assert "sse4a" not in r.stderr
+    # the escape hatch leaves stderr untouched
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60,
+        env=dict(os.environ, BENCH_NO_STDERR_FILTER="1"))
+    assert "SIGILL" in r.stderr and "sse4a" in r.stderr
 
 
 # ---- CLI surface ----

@@ -1,0 +1,294 @@
+#!/usr/bin/env python3
+"""Flow doctor: one health gate over every observability artifact the
+flow leaves behind — run it after a bench (or in CI) and a nonzero exit
+means the flow regressed or an instrument broke.
+
+Stdlib-only like its siblings (trace_report.py / ledger_report.py,
+whose --check rule sets it reuses by import): it must run anywhere the
+artifacts land, without jax or the repo on the path.
+
+    python tools/flow_doctor.py --row BENCH_r05.json --bench-dir .
+    python tools/flow_doctor.py --trace out.json --metrics metrics.json \
+                                --devprof devprof.json
+
+Checks, each skipped (with a note) when its artifact is not given:
+
+  trace    trace_report validate + pipeline-shape + counter-track rules
+  metrics  ledger_report validate (work-ledger invariants + devcost
+           gauge sanity)
+  devprof  the device-truth ledger (stats_dir/devprof.json): at least
+           one captured variant; every measured record has positive
+           measured bytes and a measured-vs-modeled delta inside the
+           declared band; all-unavailable (backend exposes no cost
+           analysis) passes with a note — absence of the instrument is
+           not a flow regression
+  row      the fresh bench row against the previous BENCH_*.json (or
+           --against FILE): nets/s must not drop more than --nets-tol
+           (default 10%), wirelength must not increase at all, the
+           pipeline fill factor keeps a floor, the wasted-sweep
+           fraction must not jump; keys missing from either row are
+           tolerated (older rows predate some riders)
+
+Exit codes: 0 healthy, 1 regression / broken invariant, 2 usage or
+unreadable artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import math
+import os
+import sys
+
+# mirrors obs/devprof.py DELTA_BAND_LOG10 (stdlib-only: no repo import)
+DEVCOST_DELTA_BAND_LOG10 = 2.0
+
+# bench-row tolerances (the CLI can override the first)
+NETS_PER_SEC_TOL = 0.10        # fresh value >= (1 - tol) * previous
+OVERLAP_FRAC_FLOOR = 0.5       # pipeline fill factor, when present
+RELAX_WASTED_FRAC_SLACK = 0.15  # fresh <= previous + slack, when both
+
+
+def _load_sibling(name: str):
+    """Import a sibling tool module by file path, so the doctor works
+    when invoked as a script (tools/ is not a package)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def check_trace(path: str) -> list:
+    tr = _load_sibling("trace_report")
+    doc = _read_json(path)
+    return (tr.validate(doc) + tr.check_pipeline(doc)
+            + tr.check_counters(doc))
+
+
+def check_metrics(path: str) -> list:
+    lr = _load_sibling("ledger_report")
+    return lr.validate(_read_json(path))
+
+
+def check_devprof(path: str) -> tuple:
+    """Returns (errors, notes)."""
+    doc = _read_json(path)
+    errs, notes = [], []
+    recs = doc.get("records")
+    if not isinstance(recs, list) or not recs:
+        return (["devprof ledger has no captured dispatch variants "
+                 "(the profiler was enabled but note_variant never "
+                 "fired — dispatch-site instrumentation is broken)"],
+                notes)
+    measured = [r for r in recs if isinstance(r, dict)
+                and "unavailable" not in r]
+    if not measured:
+        # graceful-degradation contract: a backend without cost
+        # analysis is not a flow regression
+        notes.append(f"devprof: all {len(recs)} variant(s) unavailable "
+                     f"({recs[0].get('unavailable', '?')}) — backend "
+                     f"exposes no cost analysis; skipping devcost gates")
+        return errs, notes
+    band = doc.get("delta_band_log10", DEVCOST_DELTA_BAND_LOG10)
+
+    def _in_band(bd):
+        return (isinstance(bd, (int, float)) and bd > 0
+                and abs(math.log10(bd)) <= band)
+
+    # the band gates the DOMINANT (most-nets) variant — the one the
+    # gauges and bench rows quote.  Endgame windows routing a handful
+    # of nets sit structurally off the per-net traffic model (fixed
+    # window overhead dominates), so their excursions are notes
+    dominant = max(measured,
+                   key=lambda r: (r.get("meta") or {}).get("nets", 0))
+    for r in measured:
+        key = r.get("key")
+        ba = r.get("bytes_accessed", r.get("temp_bytes"))
+        if not (isinstance(ba, (int, float)) and ba > 0):
+            errs.append(f"devprof variant {key}: measured bytes not "
+                        f"positive ({ba!r})")
+        bd = r.get("bytes_delta")
+        if bd is None or _in_band(bd):
+            continue
+        if r is dominant:
+            errs.append(f"devprof dominant variant {key}: measured/"
+                        f"modeled bytes {bd!r} outside the declared "
+                        f"1e±{band} band")
+        else:
+            notes.append(f"devprof: small variant {key} "
+                         f"({(r.get('meta') or {}).get('nets', '?')} "
+                         f"nets) off-model (delta {bd}); fixed window "
+                         f"overhead dominates below the band's scope")
+    notes.append(f"devprof: {len(measured)}/{len(recs)} variant(s) "
+                 f"measured, dominant delta "
+                 f"{dominant.get('bytes_delta', 'n/a')}")
+    return errs, notes
+
+
+def _row_of(doc):
+    """Accept either a driver capture ({"parsed": row, ...}) or a bare
+    bench row ({"metric": ..., "value": ...})."""
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc if isinstance(doc, dict) else None
+
+
+def latest_bench_rows(bench_dir: str, exclude: str = None) -> list:
+    """BENCH_*.json paths in name order (the driver numbers them), the
+    excluded path (the fresh row itself) removed."""
+    paths = sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json")))
+    if exclude:
+        ex = os.path.abspath(exclude)
+        paths = [p for p in paths if os.path.abspath(p) != ex]
+    return paths
+
+
+def check_row(fresh: dict, prev: dict, nets_tol: float) -> tuple:
+    """Compare a fresh bench row against the previous one.  Returns
+    (errors, notes); keys missing from either side are tolerated
+    (older rows predate some detail riders)."""
+    errs, notes = [], []
+    fv, pv = fresh.get("value"), prev.get("value")
+    if isinstance(fv, (int, float)) and isinstance(pv, (int, float)):
+        floor = (1.0 - nets_tol) * pv
+        if fv < floor:
+            errs.append(
+                f"{fresh.get('metric', 'value')} regressed: {fv} < "
+                f"{floor:.4g} (= previous {pv} - {nets_tol:.0%})")
+        else:
+            notes.append(f"{fresh.get('metric', 'value')}: {fv} vs "
+                         f"previous {pv} (floor {floor:.4g}) ok")
+    else:
+        notes.append("value missing from a row; throughput gate skipped")
+    fd = fresh.get("detail") or {}
+    pd = prev.get("detail") or {}
+    fw, pw = fd.get("wirelength"), pd.get("wirelength")
+    if isinstance(fw, (int, float)) and isinstance(pw, (int, float)):
+        if fw > pw:
+            errs.append(f"wirelength regressed: {fw} > previous {pw} "
+                        f"(any increase fails)")
+        else:
+            notes.append(f"wirelength: {fw} vs previous {pw} ok")
+    else:
+        notes.append("wirelength missing from a row; gate skipped")
+    of = (fd.get("pipeline") or {}).get("overlap_frac")
+    if isinstance(of, (int, float)):
+        if of < OVERLAP_FRAC_FLOOR:
+            errs.append(f"pipeline overlap_frac {of} below the "
+                        f"{OVERLAP_FRAC_FLOOR} floor: the async "
+                        f"pipeline is not filling the device")
+        else:
+            notes.append(f"pipeline overlap_frac: {of} ok")
+    wf = (fd.get("ledger") or {}).get("relax_wasted_frac")
+    pwf = (pd.get("ledger") or {}).get("relax_wasted_frac")
+    if isinstance(wf, (int, float)) and isinstance(pwf, (int, float)):
+        if wf > pwf + RELAX_WASTED_FRAC_SLACK:
+            errs.append(f"relax_wasted_frac jumped: {wf} > previous "
+                        f"{pwf} + {RELAX_WASTED_FRAC_SLACK}")
+        else:
+            notes.append(f"relax_wasted_frac: {wf} vs previous {pwf} ok")
+    dc = fd.get("devcost")
+    if isinstance(dc, dict):
+        if "unavailable" in dc:
+            notes.append(f"row devcost: unavailable "
+                         f"({dc['unavailable']})")
+        else:
+            ba = dc.get("bytes_accessed")
+            if not (isinstance(ba, (int, float)) and ba > 0):
+                errs.append(f"row devcost.bytes_accessed not positive: "
+                            f"{ba!r}")
+            if dc.get("delta_in_band") is False:
+                errs.append(
+                    f"row devcost measured/modeled bytes "
+                    f"{dc.get('bytes_delta')} outside the declared "
+                    f"1e±{dc.get('delta_band_log10')} band")
+    return errs, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", help="Chrome trace-event JSON to gate")
+    ap.add_argument("--metrics", help="metrics JSON (MetricsRegistry "
+                                      "dump) to gate")
+    ap.add_argument("--devprof", help="devprof.json (obs/devprof "
+                                      "ledger) to gate")
+    ap.add_argument("--row", help="fresh bench row (BENCH_*.json or "
+                                  "bare row JSON) to gate against the "
+                                  "previous one")
+    ap.add_argument("--against", help="explicit previous row for --row "
+                                      "(default: latest other "
+                                      "BENCH_*.json in --bench-dir)")
+    ap.add_argument("--bench-dir", default=".",
+                    help="where BENCH_*.json history lives")
+    ap.add_argument("--nets-tol", type=float, default=NETS_PER_SEC_TOL,
+                    help="allowed fractional drop in the row's metric "
+                         "of record (default %(default)s)")
+    args = ap.parse_args(argv)
+
+    if not any((args.trace, args.metrics, args.devprof, args.row)):
+        ap.error("nothing to check: give at least one of --trace / "
+                 "--metrics / --devprof / --row")
+
+    errs, notes = [], []
+    try:
+        if args.trace:
+            errs += [f"trace: {e}" for e in check_trace(args.trace)]
+            notes.append(f"trace: checked {args.trace}")
+        if args.metrics:
+            errs += [f"metrics: {e}" for e in check_metrics(args.metrics)]
+            notes.append(f"metrics: checked {args.metrics}")
+        if args.devprof:
+            de, dn = check_devprof(args.devprof)
+            errs += [f"devprof: {e}" for e in de]
+            notes += dn
+        if args.row:
+            fresh = _row_of(_read_json(args.row))
+            if fresh is None:
+                errs.append(f"row: {args.row} is not a bench row")
+            else:
+                prev_path = args.against
+                if prev_path is None:
+                    hist = latest_bench_rows(args.bench_dir,
+                                             exclude=args.row)
+                    prev_path = hist[-1] if hist else None
+                if prev_path is None:
+                    notes.append("row: no previous BENCH_*.json to "
+                                 "compare against; gates skipped")
+                else:
+                    prev = _row_of(_read_json(prev_path))
+                    if prev is None:
+                        errs.append(f"row: previous {prev_path} is not "
+                                    f"a bench row")
+                    else:
+                        re_, rn = check_row(fresh, prev, args.nets_tol)
+                        errs += [f"row: {e}" for e in re_]
+                        notes += [f"row[{os.path.basename(prev_path)}]"
+                                  f": {n}" for n in rn]
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"flow doctor: cannot read artifact: {e}",
+              file=sys.stderr)
+        return 2
+
+    for n in notes:
+        print(f"  {n}")
+    if errs:
+        print(f"UNHEALTHY: {len(errs)} problem(s)", file=sys.stderr)
+        for e in errs:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    print("HEALTHY")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

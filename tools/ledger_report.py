@@ -36,6 +36,9 @@ import sys
 LEDGER_KEYS = ("route.relax_steps", "route.relax_steps_useful",
                "route.relax_steps_wasted")
 
+# mirrors obs/devprof.py DELTA_BAND_LOG10 (stdlib-only tool: no import)
+DEVCOST_DELTA_BAND_LOG10 = 2.0
+
 
 def load(path: str) -> dict:
     with open(path) as f:
@@ -80,6 +83,21 @@ def validate(doc) -> list:
             wf - wasted / total) > 1e-3:
         errs.append(f"relax_wasted_frac {wf} inconsistent with "
                     f"counters ({wasted}/{total})")
+    # device-truth gauges (route.devcost.*, published by obs/devprof):
+    # measured bytes must be positive and the measured-vs-modeled ratio
+    # inside the declared sanity band
+    ba = values.get("route.devcost.bytes_accessed")
+    if ba is not None and not (isinstance(ba, (int, float)) and ba > 0):
+        errs.append(f"route.devcost.bytes_accessed not positive: {ba!r}")
+    bd = values.get("route.devcost.bytes_delta")
+    if bd is not None:
+        import math
+        if not (isinstance(bd, (int, float)) and bd > 0 and
+                abs(math.log10(bd)) <= DEVCOST_DELTA_BAND_LOG10):
+            errs.append(
+                f"route.devcost.bytes_delta {bd!r} outside the "
+                f"1e±{DEVCOST_DELTA_BAND_LOG10} measured-vs-modeled "
+                f"sanity band")
     # per-snapshot monotonicity: counters never decrease along the run
     prev = (0, 0, 0)
     for i, s in enumerate(doc.get("snapshots", [])):
@@ -115,6 +133,17 @@ def summarize(doc) -> str:
     if comp is not None:
         lines.append(f"  plan compaction: {comp:.2f} of full width "
                      f"(last window)")
+    ba = values.get("route.devcost.bytes_accessed")
+    if ba is not None:
+        bd = values.get("route.devcost.bytes_delta")
+        lines.append(
+            f"  device-truth cost (dominant variant): "
+            f"{values.get('route.devcost.flops', 0):.3g} flops, "
+            f"{ba:.3g} B accessed, peak temp "
+            f"{values.get('route.devcost.peak_temp_bytes', 0):.3g} B"
+            + (f", measured/modeled bytes {bd:g}" if bd is not None
+               else "")
+            + f" ({values.get('route.devcost.variants', '?')} variants)")
     # trajectory: per-snapshot deltas of the executed/wasted counters
     rows = []
     prev = (0, 0, 0)

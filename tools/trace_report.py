@@ -175,11 +175,64 @@ def check_pipeline(doc) -> list:
         errs.append(
             "pipelined route (>= 2 windows) with ZERO plan/exec "
             "overlap: the async pipeline is serialized")
-    if ov["sync_overlap_us"] > 0.0:
+    # 1us epsilon: a plan span ending at the same perf_counter instant
+    # an exec span begins can round into a sub-nanosecond sliver (the
+    # two us conversions differ in float arithmetic); a genuine leak is
+    # host work measured in milliseconds
+    if ov["sync_overlap_us"] > 1.0:
         errs.append(
             f"{ov['sync_overlap_us'] / 1e3:.3f}ms of plan spans overlap "
             f"--sync exec spans (the escape hatch drains every dispatch "
             f"before further host work; overlap there means it leaked)")
+    return errs
+
+
+def _counters(doc):
+    return [e for e in doc.get("traceEvents", [])
+            if isinstance(e, dict) and e.get("ph") == "C"]
+
+
+def check_counters(doc) -> list:
+    """Counter-track ("C" event) invariants for --check:
+
+    - args.value must be a plain number (Perfetto drops non-numeric
+      counter samples silently; we fail loudly instead).
+    - samples share the span clock origin: ts must sit inside the
+      [0, last span end + slack] envelope of the X events.  A counter
+      stamped from a different perf_counter origin lands far outside
+      and would render as a detached track.
+    - per-track ts must be non-decreasing — counters are appended from
+      metrics snapshots in wall order; a regression means two tracers'
+      events were merged or the clock origin moved mid-run.
+    """
+    cs = _counters(doc)
+    if not cs:
+        return []
+    errs = []
+    span_end = max((e["ts"] + e.get("dur", 0.0) for e in _xs(doc)),
+                   default=None)
+    envelope = None if span_end is None else span_end + 1e4  # 10ms slack
+    last_by_name = {}
+    for i, ev in enumerate(cs):
+        name = ev.get("name", "?")
+        v = ev.get("args", {}).get("value") \
+            if isinstance(ev.get("args"), dict) else None
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"counter '{name}': non-numeric value {v!r}")
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue  # validate() already flags the bad ts
+        if ts < 0 or (envelope is not None and ts > envelope):
+            errs.append(
+                f"counter '{name}': ts {ts:.0f}us outside the span "
+                f"clock envelope [0, {envelope:.0f}]us — sample is off "
+                f"the tracer's clock origin")
+        prev = last_by_name.get(name)
+        if prev is not None and ts < prev:
+            errs.append(f"counter '{name}': ts {ts:.0f}us < previous "
+                        f"sample {prev:.0f}us (track not monotone)")
+        last_by_name[name] = ts
     return errs
 
 
@@ -250,6 +303,18 @@ def summarize(doc) -> str:
             f"{ov['windows']} windows, {ov['exec_spans']} exec / "
             f"{ov['plan_spans']} plan spans)")
 
+    cs = _counters(doc)
+    if cs:
+        by_name = {}
+        for e in cs:
+            v = e.get("args", {}).get("value")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                by_name.setdefault(e.get("name", "?"), []).append(v)
+        parts = [f"{n} [{min(vs):g}..{max(vs):g}] x{len(vs)}"
+                 for n, vs in sorted(by_name.items())]
+        lines.append(f"counter tracks: {len(by_name)} track(s), "
+                     f"{len(cs)} samples: " + ", ".join(parts))
+
     compile_us = sum(e["dur"] for e in evs
                      if e.get("cat") == "jax.compile")
     total_us = max((e["ts"] + e["dur"] for e in evs), default=0)
@@ -282,7 +347,7 @@ def main(argv=None) -> int:
         print(f"MALFORMED: {e}", file=sys.stderr)
         return 2
 
-    errs = validate(doc) + check_pipeline(doc)
+    errs = validate(doc) + check_pipeline(doc) + check_counters(doc)
     if args.check:
         if errs:
             print("MALFORMED trace:", file=sys.stderr)
