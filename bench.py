@@ -153,35 +153,74 @@ def init_backend(retries: int = 3, delay_s: float = 20.0,
     return jax.devices()[0].platform
 
 
-def _recorded_path(args) -> str:
-    """Canonical on-repo location of the most recent ON-CHIP result for
-    this exact bench config (VERDICT r4 weak#1: a wedged tunnel must
-    never turn the round's number of record into a silent CPU fallback
-    while real device data exists)."""
+def _config_key(args) -> str:
+    """Canonical id of this exact bench config — shared by the on-chip
+    replay store (bench_tpu/<key>.json) and the run corpus scenario id
+    (runs/<key>.jsonl), so the corpus trajectory and the replay
+    contract name the same thing."""
     if args.place_only:
-        key = (f"place_l{args.luts}_w{args.chan_width}"
-               f"_m{args.moves_per_step}")
-    elif args.sweep_only:
-        key = (f"sweep_{args.program}_c{args.sweep_crop}_b{args.batch}"
-               f"_g{args.sweep_max_grid}")
-    else:
-        # _d suffix only for non-default divs: the default-config key
-        # must stay stable or previously recorded on-chip results would
-        # be orphaned (the replay contract exists to prevent exactly
-        # that failure)
-        from parallel_eda_tpu.route import RouterOpts as _RO
-        div = (f"_d{args.budget_div}"
-               if args.budget_div != _RO().sweep_budget_div else "")
-        key = (f"scale{int(bool(args.scale))}_l{args.luts}"
-               f"_w{args.chan_width}_{args.program}_b{args.batch}{div}")
+        return (f"place_l{args.luts}_w{args.chan_width}"
+                f"_m{args.moves_per_step}")
+    if args.sweep_only:
+        return (f"sweep_{args.program}_c{args.sweep_crop}_b{args.batch}"
+                f"_g{args.sweep_max_grid}")
+    # _d suffix only for non-default divs: the default-config key
+    # must stay stable or previously recorded on-chip results would
+    # be orphaned (the replay contract exists to prevent exactly
+    # that failure)
+    from parallel_eda_tpu.route import RouterOpts as _RO
+    div = (f"_d{args.budget_div}"
+           if args.budget_div != _RO().sweep_budget_div else "")
+    return (f"scale{int(bool(args.scale))}_l{args.luts}"
+            f"_w{args.chan_width}_{args.program}_b{args.batch}{div}")
+
+
+def _recorded_path(args) -> str:
+    """On-repo location of the most recent ON-CHIP result for this
+    exact bench config (VERDICT r4 weak#1: a wedged tunnel must never
+    turn the round's number of record into a silent CPU fallback while
+    real device data exists)."""
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "bench_tpu", f"{key}.json")
+                        "bench_tpu", f"{_config_key(args)}.json")
 
 
-def emit(args, line: dict) -> None:
+def _runstore():
+    from parallel_eda_tpu.obs import runstore
+    return runstore
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        return getattr(d, "device_kind", "") or d.platform
+    except Exception:
+        return "unknown"
+
+
+def emit(args, line: dict, gauges=None, series=None,
+         congestion=None, qor=None) -> None:
     """Print the bench line; if it ran on the chip, also record it so a
-    later wedged-tunnel run can replay it (explicitly tagged)."""
-    if line.get("detail", {}).get("platform") == "tpu":
+    later wedged-tunnel run can replay it (explicitly tagged).  Every
+    emitted row is stamped with provenance (schema_version, ts, git
+    rev, backend, device kind, scenario — so a captured BENCH_*.json is
+    self-describing and flow_doctor can refuse cross-backend diffs) and,
+    unless --no_corpus, appended to the runs/<scenario>.jsonl corpus."""
+    rs = _runstore()
+    line = dict(line)
+    detail = line.get("detail") or {}
+    backend = detail.get("platform") or "unknown"
+    scenario = _config_key(args)
+    line.update({
+        "schema_version": rs.SCHEMA_VERSION,
+        "ts": rs.now_iso(),
+        "git_rev": rs.git_rev(os.path.dirname(os.path.abspath(__file__))),
+        "backend": backend,
+        "device_kind": _device_kind(),
+        "scenario": scenario,
+    })
+    if backend == "tpu":
         p = _recorded_path(args)
         os.makedirs(os.path.dirname(p), exist_ok=True)
         rec = dict(line)
@@ -190,6 +229,24 @@ def emit(args, line: dict) -> None:
         with open(p, "w") as f:
             json.dump(rec, f)
     print(json.dumps(line))
+    if getattr(args, "no_corpus", False):
+        return
+    # corpus append must never kill the bench line it rides on
+    try:
+        tags = {}
+        if detail.get("replay"):
+            tags["replay"] = True
+        rec = rs.make_record(
+            scenario, {k: v for k, v in sorted(vars(args).items())},
+            line.get("metric", "unknown"), line.get("value", -1.0),
+            line.get("unit", "none"), backend, line["device_kind"],
+            qor=qor, gauges=gauges, series=series,
+            congestion=congestion, detail=detail or None,
+            tags=tags or None, ts=line["ts"], rev=line["git_rev"])
+        path = rs.append_run(getattr(args, "runs_dir", "runs"), rec)
+        log(f"corpus: appended {scenario} row to {path}")
+    except Exception as e:
+        log(f"corpus append failed (non-fatal): {type(e).__name__}: {e}")
 
 
 def replay_recorded(args):
@@ -531,6 +588,19 @@ def main():
                          "(RouterOpts.compile_cache_dir): a second run "
                          "deserializes the route window programs "
                          "instead of recompiling them")
+    ap.add_argument("--runs_dir",
+                    default=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "runs"),
+                    help="run-corpus directory: every bench run appends "
+                         "one runs/<scenario>.jsonl record "
+                         "(obs/runstore.py schema; default %(default)s)")
+    ap.add_argument("--no_corpus", action="store_true",
+                    help="skip the corpus append (one-off experiments "
+                         "that must not pollute the trajectory)")
+    ap.add_argument("--trace_out", default=None,
+                    help="export a Chrome trace-event JSON of the "
+                         "measured route to this path (obs tracer)")
     args = ap.parse_args()
     serial_error = None
     if args.budget_div is None:
@@ -579,6 +649,9 @@ def main():
                                       get_devprof, get_metrics)
     enable_compile_capture()
     get_metrics().enabled = True
+    if args.trace_out:
+        from parallel_eda_tpu.obs import Tracer, set_tracer
+        set_tracer(Tracer())
     # device-truth profiler: notes every dispatch variant (warmup
     # included — its own seen-set is fresh even on a warm jit cache);
     # the AOT capture runs after the measured route
@@ -730,6 +803,28 @@ def main():
         speedup = sdt_eff / max(dt, 1e-9)
 
     mv = get_metrics().values("route.")
+    # corpus riders: the full route.* gauge snapshot, the per-iteration
+    # overuse/pres_fac trajectories, and the per-window congestion
+    # heatmap rasterized from the router's top_overused ids (extent is
+    # the grid plus the IO ring)
+    reg = get_metrics()
+    corpus_series = {
+        "overused_nodes": [int(s.overused_nodes) for s in res.stats],
+        "overuse_total": [int(s.overuse_total) for s in res.stats],
+        "pres_fac": reg.series("route.pres_fac", phase="route"),
+    }
+    corpus_congestion = _runstore().congestion_blob(
+        res.congestion, rr.xlow, rr.ylow, rr.xhigh, rr.yhigh,
+        rr.grid.nx + 2, rr.grid.ny + 2)
+    corpus_qor = {"wirelength": int(res.wirelength),
+                  "routed": bool(res.success),
+                  "iterations": int(res.iterations)}
+    if args.trace_out:
+        from parallel_eda_tpu.obs import get_tracer
+        tr = get_tracer()
+        if tr is not None:
+            tr.export(args.trace_out)
+            log(f"trace exported to {args.trace_out}")
     emit(args, {
         "metric": "nets_routed_per_sec",
         "value": round(float(nets_per_sec), 2),
@@ -844,7 +939,8 @@ def main():
                     max(0.0, dt - compile_measured_s), 3),
             },
         },
-    })
+    }, gauges=mv, series=corpus_series, congestion=corpus_congestion,
+        qor=corpus_qor)
 
 
 if __name__ == "__main__":

@@ -171,6 +171,15 @@ class RouterOpts:
     # ~11s on the second process run (the cache holds every window
     # variant; residual time is trace/lower + deserialize)
     compile_cache_dir: Optional[str] = None
+    # per-window congestion telemetry (the observatory corpus feed,
+    # obs/runstore.py): after every committed window, record the top-k
+    # overused rr-node ids into result.congestion — in --sync from the
+    # live occupancy before the next dispatch donates it, in pipelined
+    # mode from a non-donated device snapshot whose D2H readback
+    # overlaps the next window's execution.  Also the top_overused
+    # source for the mdclog congestion records.  0 disables the
+    # capture (mdclog records then carry an empty list)
+    congestion_topk: int = 8
 
 
 @dataclass
@@ -246,6 +255,12 @@ class RouteResult:
     windowed_nets: int = 0
     # latest window-boundary state snapshot (opts.checkpoint_every > 0)
     checkpoint: Optional["RouteCheckpoint"] = None
+    # per-window congestion records (opts.congestion_topk > 0, planes
+    # program): [{window, iteration, overused_nodes, overuse_total,
+    # pres_fac, top_overused: [[node, overuse], ...]}, ...] — the
+    # spatial telemetry obs/runstore.py rasterizes into the corpus
+    # heatmaps.  Captured in BOTH pipelined and --sync modes.
+    congestion: List[dict] = field(default_factory=list)
 
 
 def _color_schedule(idx: np.ndarray, conflict: np.ndarray):
@@ -597,6 +612,7 @@ class Router:
         # uploads) + persistent compile cache, both for the pipelined
         # window driver
         self._staging = _PlanStaging()
+        self._cap_np = None    # host capacity copy for congestion top-k
         if self.opts.compile_cache_dir:
             enable_persistent_compile_cache(self.opts.compile_cache_dir)
         self._s_batch = self._s_node = None
@@ -747,6 +763,24 @@ class Router:
                          bucket_occ=bk["bucket_occ"],
                          compaction=bk["compaction"],
                          kernel_plans=bk["kplans"], tw1=bk["tw1"])
+        # congestion record (corpus + mdclog): in pipelined mode the
+        # occ_ref is a non-donated snapshot whose copy_to_host_async
+        # was started at the control point — by now (the NEXT window is
+        # executing) the np.asarray below consumes an already-streamed
+        # host copy, so --sync is not required for congestion telemetry
+        top = []
+        if bk.get("occ_ref") is not None:
+            if self._cap_np is None:
+                self._cap_np = np.asarray(self.dev.capacity)
+            k = self.opts.congestion_topk
+            top = _top_overused(bk["occ_ref"], self._cap_np,
+                                k=k if k > 0 else _CONGESTION_TOPK)
+            result.congestion.append({
+                "window": bk["widx"], "iteration": bk["it_done"],
+                "overused_nodes": bk["n_over"],
+                "overuse_total": bk["over_total"],
+                "pres_fac": round(bk["pres"], 6),
+                "top_overused": top})
         if mlog.enabled:
             mlog.set_mdc(bk["widx"])
             mlog.log("route", iteration=bk["it_done"], K=bk["K"],
@@ -756,10 +790,7 @@ class Router:
                      overuse_total=bk["over_total"],
                      pres_fac=round(bk["pres"], 4),
                      widened=bk["widened"],
-                     top_overused=(
-                         _top_overused(bk["occ_ref"],
-                                       self.dev.capacity)
-                         if bk.get("occ_ref") is not None else []))
+                     top_overused=top)
             mlog.log("schedule", colors=bk["colors_max"],
                      dirty_next=bk["dirty_next"],
                      precise=bk["precise"],
@@ -768,6 +799,24 @@ class Router:
                 mlog.log("timing", crit_path_delay=bk["cpd"],
                          dmax_hist=[None if d != d else float(d)
                                     for d in bk["dmax_hist"].tolist()])
+
+    def _occ_snapshot(self, occ, pipelined: bool, mlog):
+        """Occupancy reference for one window's congestion record
+        (None = telemetry off).  --sync returns the live array — the
+        record is booked inline, before the next dispatch donates it.
+        Pipelined mode takes a NON-donated device copy and starts its
+        host readback immediately: the copy streams D2H while the next
+        window executes, and _book_window consumes it without a sync
+        (occ itself is donated into the next dispatch; reading the
+        donated buffer later would fail)."""
+        if self.opts.congestion_topk <= 0 and not mlog.enabled:
+            return None
+        if not pipelined:
+            return occ
+        snap = occ + 0
+        if hasattr(snap, "copy_to_host_async"):
+            snap.copy_to_host_async()
+        return snap
 
     def _obs_final(self, result: "RouteResult") -> None:
         """End-of-route registry state: the converged numbers every
@@ -1446,11 +1495,12 @@ class Router:
                 dirty_next=int(rrm.sum()), precise=precise,
                 sweep_boost=sweep_boost, widened=result.widened_nets,
                 dmax_hist=dmax_hist,
-                # occ snapshot for the congestion top-k: only in mdclog
-                # runs, which force the synchronous driver — there the
-                # record is booked inline, before the next dispatch
-                # donates this array
-                occ_ref=(occ if mlog.enabled else None))
+                # occ snapshot for the congestion top-k: inline in
+                # --sync (booked before the next dispatch donates the
+                # array), a non-donated async-readback copy when
+                # pipelined — congestion telemetry no longer requires
+                # the synchronous driver
+                occ_ref=self._occ_snapshot(occ, pipelined, mlog))
             if analyzer is not None and cpd == cpd:
                 analyzer.crit_path_delay = cpd
             if not pipelined:

@@ -39,6 +39,14 @@ def main():
                     help="print only the memory model (small fixture, "
                          "Titan-proxy extrapolation); no routing")
     ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--runs_dir",
+                    default=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "runs"),
+                    help="run-corpus directory (obs/runstore.py); the "
+                         "end-to-end route appends one record")
+    ap.add_argument("--no_corpus", action="store_true",
+                    help="skip the corpus append")
     args = ap.parse_args()
     if args.curve_only and args.memory_only:
         ap.error("--curve_only and --memory_only are mutually exclusive")
@@ -171,6 +179,61 @@ def main():
                   f"peak temp {dc.get('temp_bytes', 0)} B, "
                   f"measured/modeled bytes {dc.get('bytes_delta')} "
                   f"(band 1e±{dc.get('delta_band_log10')})")
+        # corpus append (obs/runstore.py): the scale route joins the
+        # same trajectory store the 60-LUT bench feeds, under its own
+        # scenario id.  Never fatal to the study output.
+        if not args.no_corpus:
+            try:
+                from parallel_eda_tpu.obs import runstore as _rs
+                backend = "tpu" if args.tpu else "cpu"
+                dev0 = jax.devices()[0]
+                scen = f"scale_bench_l{args.big}_b{args.batch}"
+                rec = _rs.make_record(
+                    scen,
+                    {"big": args.big, "batch": args.batch,
+                     "tpu": bool(args.tpu)},
+                    "nets_routed_per_sec",
+                    round(res.total_net_routes / max(t_route, 1e-9), 2),
+                    "nets/s", backend,
+                    getattr(dev0, "device_kind", "") or dev0.platform,
+                    qor={"wirelength": int(res.wirelength),
+                         "routed": bool(res.success),
+                         "iterations": int(res.iterations)},
+                    gauges=get_metrics().values("route."),
+                    series={"overused_nodes":
+                            [int(s.overused_nodes) for s in res.stats],
+                            "overuse_total":
+                            [int(s.overuse_total) for s in res.stats]},
+                    congestion=_rs.congestion_blob(
+                        res.congestion, f.rr.xlow, f.rr.ylow,
+                        f.rr.xhigh, f.rr.yhigh,
+                        f.rr.grid.nx + 2, f.rr.grid.ny + 2),
+                    detail={
+                        "platform": backend,
+                        "luts": int(args.big),
+                        "rr_nodes": int(f.rr.num_nodes),
+                        "route_time_s": round(t_route, 3),
+                        "total_net_routes": int(res.total_net_routes),
+                        "total_relax_steps": int(res.total_relax_steps),
+                        "wirelength": int(res.wirelength),
+                        "ledger": {
+                            "relax_steps_useful":
+                                int(res.total_relax_steps_useful),
+                            "relax_steps_wasted":
+                                int(res.total_relax_steps_wasted)},
+                        "pipeline": {
+                            "exec_ms": pv.get(
+                                "route.pipeline.device_exec_ms_total"),
+                            "stall_ms": pv.get(
+                                "route.pipeline.stall_ms_total")},
+                        "obs": {"compile_s_measured": round(c_route, 3)},
+                    },
+                    repo_dir=os.path.dirname(os.path.abspath(__file__)))
+                p = _rs.append_run(args.runs_dir, rec)
+                log(f"corpus: appended {scen} row to {p}")
+            except Exception as e:
+                log(f"corpus append failed (non-fatal): "
+                    f"{type(e).__name__}: {e}")
         print(f"- legality: verified by the independent checker (run_route)")
         print(f"- obs: {res.iterations} route iterations, overuse "
               f"trajectory {[s.overused_nodes for s in res.stats]}, "

@@ -207,6 +207,128 @@ def test_config_of_record_row_is_healthy():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+# ---- cross-backend refusal + corpus gates ----
+
+def test_row_backend_resolution():
+    fd = _load()
+    assert fd._row_backend({"backend": "tpu"}) == "tpu"
+    # older rows: fall back to detail.platform
+    assert fd._row_backend({"detail": {"platform": "cpu"}}) == "cpu"
+    assert fd._row_backend({"backend": "tpu",
+                            "detail": {"platform": "cpu"}}) == "tpu"
+    assert fd._row_backend({}) == ""
+    assert fd._row_backend(None) == ""
+
+
+def test_cross_backend_rows_skip_with_warning(tmp_path):
+    """The r04/r05 lesson as a contract: a cpu row is never gated
+    against a tpu row — warning note, exit 0, even when the values
+    would otherwise scream regression."""
+    prev = tmp_path / "BENCH_r01.json"
+    fresh = tmp_path / "BENCH_r02.json"
+    prev.write_text(json.dumps(
+        {"n": 1, "parsed": _row(value=90.0, platform="tpu")}))
+    tpu_row = json.loads(prev.read_text())["parsed"]
+    tpu_row["backend"] = "tpu"
+    prev.write_text(json.dumps({"n": 1, "parsed": tpu_row}))
+    cpu_row = _row(value=30.0)             # -66% vs the tpu row
+    cpu_row["backend"] = "cpu"
+    fresh.write_text(json.dumps({"n": 2, "parsed": cpu_row}))
+    r = subprocess.run(
+        [sys.executable, FLOW_DOCTOR, "--row", str(fresh),
+         "--bench-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "WARNING" in r.stdout and "backends differ" in r.stdout
+
+
+def _corpus(tmp_path, rows, scenario="bench"):
+    """Write corpus rows via the runstore itself (schema-checked)."""
+    fd = _load()
+    rs = fd._load_runstore()
+    runs = str(tmp_path / "runs")
+    for i, (value, backend, wl, tags) in enumerate(rows):
+        rs.append_run(runs, rs.make_record(
+            scenario, {"luts": 60}, "nets_routed_per_sec", value,
+            "nets/s", backend, backend,
+            qor={"wirelength": wl}, tags=tags,
+            ts=f"t{i}", rev="abc1234"))
+    return runs
+
+
+def test_corpus_clean_row_passes(tmp_path):
+    fd = _load()
+    runs = _corpus(tmp_path, [
+        (80.0, "cpu", 537, None), (84.0, "cpu", 537, None),
+        (83.0, "cpu", 537, None)])
+    errs, notes = fd.check_corpus(runs, "bench", 0.10, 5)
+    assert errs == [], errs
+    assert any("median" in n for n in notes)
+
+
+def test_corpus_value_regression_fails(tmp_path):
+    fd = _load()
+    runs = _corpus(tmp_path, [
+        (80.0, "cpu", 537, None), (84.0, "cpu", 537, None),
+        (60.0, "cpu", 537, None)])          # ~27% under the median
+    errs, _ = fd.check_corpus(runs, "bench", 0.10, 5)
+    assert any("regressed" in e for e in errs)
+
+
+def test_corpus_wirelength_regression_fails(tmp_path):
+    fd = _load()
+    runs = _corpus(tmp_path, [
+        (80.0, "cpu", 537, None), (84.0, "cpu", 537, None),
+        (84.0, "cpu", 544, None)])          # any wl increase fails
+    errs, _ = fd.check_corpus(runs, "bench", 0.10, 5)
+    assert any("wirelength" in e for e in errs)
+
+
+def test_corpus_cross_backend_and_legacy_never_gate(tmp_path):
+    """A fresh cpu row whose only history is tpu rows (or pre_pr2
+    imports) has no trajectory: skip-note, no error — cross-backend
+    medians were the exact failure this mode exists to prevent."""
+    fd = _load()
+    runs = _corpus(tmp_path, [
+        (30.0, "cpu", 600, {"pre_pr2": True}),  # legacy era
+        (90.0, "tpu", 537, None),               # other backend
+        (80.0, "cpu", 537, None)])              # the fresh row
+    errs, notes = fd.check_corpus(runs, "bench", 0.10, 5)
+    assert errs == [], errs
+    assert any("skipped" in n for n in notes)
+
+
+def test_corpus_cli_exit_codes(tmp_path):
+    """The acceptance criterion: 0 on a clean re-run, 1 on an injected
+    wirelength regression, 2 when the corpus is missing."""
+    runs = _corpus(tmp_path, [
+        (80.0, "cpu", 537, None), (84.0, "cpu", 537, None)])
+
+    def run(extra=()):
+        return subprocess.run(
+            [sys.executable, FLOW_DOCTOR, "--corpus", "--runs-dir",
+             runs, *extra], capture_output=True, text=True, timeout=60)
+
+    r = run()
+    assert r.returncode == 0 and "HEALTHY" in r.stdout, \
+        r.stdout + r.stderr
+    # inject a wirelength regression as the freshest row
+    fd = _load()
+    rs = fd._load_runstore()
+    rs.append_run(runs, rs.make_record(
+        "bench", {"luts": 60}, "nets_routed_per_sec", 84.0, "nets/s",
+        "cpu", "cpu", qor={"wirelength": 551}, ts="t9", rev="abc1234"))
+    r = run()
+    assert r.returncode == 1 and "wirelength" in r.stderr
+    r = run(("--scenario", "absent"))
+    assert r.returncode == 1               # named scenario must exist
+    r = subprocess.run(
+        [sys.executable, FLOW_DOCTOR, "--corpus", "--runs-dir",
+         str(tmp_path / "nope")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+
+
 def test_trace_and_metrics_passthrough(tmp_path):
     """The doctor reuses the report tools' rule sets wholesale."""
     fd = _load()

@@ -1,0 +1,255 @@
+"""Observatory analysis layer (tools/observatory.py): regression
+attribution math on synthetic rows, legacy import, congestion export,
+and the report CLI.  Stdlib-only tool, so these run without jax.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OBSERVATORY = os.path.join(REPO, "tools", "observatory.py")
+
+pytestmark = pytest.mark.observatory
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("observatory",
+                                                  OBSERVATORY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _route_rec(rs, value, n=2000, T=None, useful=300, wasted=100,
+               exec_ms=None, stall_ms=2000.0, compile_s=3.0,
+               backend="cpu", ts="2026-08-01", wirelength=537, **kw):
+    """A synthetic corpus row shaped like bench.py's: detail carries
+    the stage-attribution substrate."""
+    T = T if T is not None else n / value
+    steps = useful + wasted
+    exec_ms = exec_ms if exec_ms is not None else \
+        (T - compile_s - stall_ms / 1e3) * 1e3 * 0.9
+    detail = {"platform": backend, "total_net_routes": n,
+              "route_time_s": T,
+              "ledger": {"relax_steps_useful": useful,
+                         "relax_steps_wasted": wasted},
+              "pipeline": {"exec_ms": exec_ms, "stall_ms": stall_ms},
+              "obs": {"compile_s_measured": compile_s}}
+    return rs.make_record("bench", {"luts": 60}, "nets_routed_per_sec",
+                          value, "nets/s", backend, "cpu",
+                          qor={"wirelength": wirelength},
+                          detail=detail, ts=ts, rev="abc1234", **kw)
+
+
+# ---- attribution math ----
+
+def test_stage_params_reconstructs_rate():
+    ob = _load()
+    rs = ob.load_runstore()
+    rec = _route_rec(rs, value=80.0, n=2000)
+    p = ob.stage_params(rec)
+    # the model's T is exact by construction (other_s is the signed
+    # residual), so the modeled rate IS the recorded one
+    assert ob.model_rate(p) == pytest.approx(80.0, rel=1e-9)
+    assert p["useful_sweeps"] == 300 and p["wasted_sweeps"] == 100
+    assert p["compile_s"] == 3.0 and p["stall_s"] == 2.0
+
+
+def test_attribution_stages_sum_to_total_delta():
+    """The acceptance-criteria property: stage contributions sum to the
+    total nets/s delta (telescoping substitution makes it exact; the
+    5% budget in the CLI only absorbs JSON rounding of `value`)."""
+    ob = _load()
+    rs = ob.load_runstore()
+    a = _route_rec(rs, value=70.0, n=2000, useful=400, wasted=200,
+                   stall_ms=4000.0, compile_s=5.0, ts="t1")
+    b = _route_rec(rs, value=84.0, n=1800, useful=300, wasted=80,
+                   stall_ms=1500.0, compile_s=2.0, ts="t2")
+    att = ob.attribute(a, b)
+    assert att is not None
+    ssum = sum(st["delta"] for st in att["stages"])
+    assert ssum == pytest.approx(att["total_delta"], rel=1e-9)
+    assert att["total_delta"] == pytest.approx(
+        att["rate_after"] - att["rate_before"], rel=1e-9)
+    # modeled endpoints match the recorded values
+    assert att["rate_before"] == pytest.approx(70.0, rel=1e-9)
+    assert att["rate_after"] == pytest.approx(84.0, rel=1e-9)
+    assert abs(ssum - att["measured_delta"]) <= 0.05 * abs(
+        att["measured_delta"])
+    # every ISSUE-named stage is present
+    names = {st["stage"] for st in att["stages"]}
+    assert names == {"iterations", "wasted_sweeps", "kernel_per_sweep",
+                     "compile", "stall", "other_host"}
+
+
+def test_attribution_isolates_the_regressed_stage():
+    """Change ONLY the wasted-sweep count: the wasted_sweeps stage
+    carries (essentially all of) the delta, other stages ~0."""
+    ob = _load()
+    rs = ob.load_runstore()
+    n, useful, wasted_a, per_sweep = 2000, 300, 50, 0.05
+    compile_s, stall_s = 3.0, 2.0
+
+    def mk(wasted, ts):
+        T = compile_s + stall_s + (useful + wasted) * per_sweep
+        return _route_rec(rs, value=round(n / T, 2), n=n, T=T,
+                          useful=useful, wasted=wasted,
+                          exec_ms=(useful + wasted) * per_sweep * 1e3,
+                          stall_ms=stall_s * 1e3, compile_s=compile_s,
+                          ts=ts)
+
+    att = ob.attribute(mk(50, "t1"), mk(350, "t2"))
+    by = {st["stage"]: st["delta"] for st in att["stages"]}
+    assert att["total_delta"] < 0          # more waste = slower
+    assert by["wasted_sweeps"] == pytest.approx(att["total_delta"],
+                                                rel=1e-6)
+    for name in ("iterations", "kernel_per_sweep", "compile", "stall"):
+        assert abs(by[name]) < 1e-9
+
+
+def test_attribution_degrades_on_sparse_rows():
+    ob = _load()
+    rs = ob.load_runstore()
+    # value+total_net_routes alone still model (T reconstructed)
+    bare = rs.make_record("s", {}, "nets_routed_per_sec", 50.0,
+                          "nets/s", "cpu", "cpu",
+                          detail={"total_net_routes": 1000},
+                          ts="t1", rev="r")
+    assert ob.stage_params(bare) is not None
+    # nothing to model -> attribution declines rather than lies
+    empty = rs.make_record("s", {}, "nets_routed_per_sec", 50.0,
+                           "nets/s", "cpu", "cpu", ts="t2", rev="r")
+    assert ob.stage_params(empty) is None
+    assert ob.attribute(bare, empty) is None
+
+
+def test_pick_attribution_pair_same_backend_only():
+    ob = _load()
+    rs = ob.load_runstore()
+    a = _route_rec(rs, value=70.0, ts="t1")
+    b = _route_rec(rs, value=90.0, backend="tpu", ts="t2")
+    c = _route_rec(rs, value=84.0, ts="t3")
+    pair = ob.pick_attribution_pair([a, b, c])
+    assert pair == (a, c)                  # the tpu row never pairs
+    legacy = _route_rec(rs, value=30.0, ts="t0",
+                        tags={"pre_pr2": True})
+    assert ob.pick_attribution_pair([legacy, a, c]) == (a, c)
+    assert ob.pick_attribution_pair([b, c]) is None
+
+
+# ---- legacy import ----
+
+def _legacy_fixtures(d):
+    (d / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "python bench.py", "rc": 1,
+         "tail": "backend probe failed", "parsed": None}))
+    (d / "BENCH_r03.json").write_text(json.dumps(
+        {"n": 3, "cmd": "python bench.py", "rc": 0,
+         "tail": "ok", "parsed": {
+             "metric": "nets_routed_per_sec", "value": 32.6,
+             "unit": "nets/s", "vs_baseline": 0.05,
+             "detail": {"platform": "cpu", "luts": 60,
+                        "wirelength": 537, "routed": True,
+                        "iterations": 22}}}))
+    (d / "MULTICHIP_r02.json").write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+         "tail": "mesh (2, 4), 6 iters, wirelength 110"}))
+
+
+def test_import_legacy_idempotent(tmp_path, capsys):
+    ob = _load()
+    rs = ob.load_runstore()
+    _legacy_fixtures(tmp_path)
+    runs = str(tmp_path / "runs")
+    assert ob.import_legacy(rs, runs, str(tmp_path)) == 0
+    bench = rs.read_runs(runs, "scale0_l60_w12_planes_b64")
+    assert len(bench) == 2
+    assert all(r["tags"]["pre_pr2"] for r in bench)
+    r01 = next(r for r in bench
+               if r["tags"]["legacy_file"] == "BENCH_r01.json")
+    assert r01["metric"] == "error" and r01["tags"].get("error")
+    r03 = next(r for r in bench
+               if r["tags"]["legacy_file"] == "BENCH_r03.json")
+    assert r03["value"] == 32.6 and r03["backend"] == "cpu"
+    assert r03["qor"]["wirelength"] == 537
+    mc = rs.read_runs(runs, "multichip_dryrun_d8")
+    assert len(mc) == 1 and mc[0]["value"] == 1.0
+    assert mc[0]["qor"] == {"mesh": [2, 4], "iterations": 6,
+                            "wirelength": 110}
+    # second import is a no-op (keyed on tags.legacy_file)
+    capsys.readouterr()
+    assert ob.import_legacy(rs, runs, str(tmp_path)) == 0
+    assert "imported 0" in capsys.readouterr().out
+    assert len(rs.read_runs(runs, "scale0_l60_w12_planes_b64")) == 2
+
+
+def test_import_legacy_rows_never_gate(tmp_path):
+    """pre_pr2 rows must not enter a corpus trajectory: the ~30 nets/s
+    legacy era would otherwise drag the median under any fresh row."""
+    ob = _load()
+    rs = ob.load_runstore()
+    _legacy_fixtures(tmp_path)
+    runs = str(tmp_path / "runs")
+    ob.import_legacy(rs, runs, str(tmp_path))
+    recs = rs.read_runs(runs, "scale0_l60_w12_planes_b64")
+    assert rs.latest_same_backend(recs, "cpu", 5) == []
+
+
+# ---- congestion export ----
+
+def test_export_congestion(tmp_path, capsys):
+    ob = _load()
+    rs = ob.load_runstore()
+    runs = str(tmp_path / "runs")
+    cong = {"bins": 4, "extent": [4, 4],
+            "windows": [{"window": 0, "iteration": 1,
+                         "overused_nodes": 1, "overuse_total": 3,
+                         "pres_fac": 0.5, "points": [[1, 1, 3]]}],
+            "heatmap": rs.rasterize([[1, 1, 3]], 4, 4, 4)}
+    rs.append_run(runs, _route_rec(rs, value=84.0, ts="t1",
+                                   congestion=cong))
+    rs.append_run(runs, _route_rec(rs, value=85.0, ts="t2"))  # no cong
+    out = str(tmp_path / "corpus.json")
+    assert ob.export_congestion(rs, runs, out) == 0
+    doc = json.loads(open(out).read())
+    assert doc["schema_version"] == rs.SCHEMA_VERSION
+    runs_out = doc["scenarios"]["bench"]
+    assert len(runs_out) == 1              # congestion-less rows skipped
+    assert runs_out[0]["heatmap"][1][1] == 3
+    # --bins re-rasters from the stored points
+    assert ob.export_congestion(rs, runs, out, bins=2) == 0
+    doc = json.loads(open(out).read())
+    assert doc["scenarios"]["bench"][0]["bins"] == 2
+    capsys.readouterr()
+    # an empty corpus is a usage error, not a silent success
+    assert ob.export_congestion(rs, str(tmp_path / "nope"), None) == 2
+
+
+# ---- report CLI ----
+
+def test_report_cli_prints_trend_and_attribution(tmp_path):
+    ob = _load()
+    rs = ob.load_runstore()
+    runs = str(tmp_path / "runs")
+    rs.append_run(runs, _route_rec(rs, value=70.0, ts="t1"))
+    rs.append_run(runs, _route_rec(rs, value=84.0, ts="t2",
+                                   useful=250, wasted=60))
+    r = subprocess.run(
+        [sys.executable, OBSERVATORY, "report", "--runs", runs],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "## bench" in r.stdout
+    assert "attribution t1" in r.stdout
+    assert "wasted_sweeps" in r.stdout and "stall" in r.stdout
+    assert "stage sum" in r.stdout
+    # empty corpus -> exit 2
+    r = subprocess.run(
+        [sys.executable, OBSERVATORY, "report", "--runs",
+         str(tmp_path / "empty")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 2

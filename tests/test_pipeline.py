@@ -193,6 +193,29 @@ def test_parity_bench_arch():
 
 
 @pytest.mark.slow
+def test_congestion_telemetry_pipelined_matches_sync():
+    """Per-window congestion records (RouteResult.congestion, the
+    observatory corpus feed) are captured in PIPELINED mode too — from
+    the non-donated async occ snapshot — and match the --sync run's
+    record for record.  --sync is no longer required for congestion
+    telemetry."""
+    from parallel_eda_tpu.flow import synth_flow
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=7)
+    res_p, res_s = _route_both_modes(f.rr, f.term, batch_size=32)
+    _assert_bit_identical(res_p, res_s)
+    assert res_p.congestion, "pipelined run captured no congestion"
+    assert res_p.congestion == res_s.congestion
+    rec = res_p.congestion[0]
+    assert {"window", "iteration", "overused_nodes", "overuse_total",
+            "pres_fac", "top_overused"} <= set(rec)
+    # top_overused entries are [node, overuse] with real overuse
+    for node, over in (e for r in res_p.congestion
+                       for e in r["top_overused"]):
+        assert 0 <= node < f.rr.num_nodes and over > 0
+
+
+@pytest.mark.slow
 def test_parity_directional_arch():
     """Same parity gate on a unidirectional (single-driver) graph —
     the directed planes masks exercise different window shapes."""

@@ -1,0 +1,173 @@
+"""Run-corpus store (parallel_eda_tpu/obs/runstore.py): append/read
+round-trip, schema floor, trajectory filtering, and the congestion
+heatmap rasterization.  Stdlib-only module, so these run without jax.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.observatory
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "runstore", os.path.join(REPO, "parallel_eda_tpu", "obs",
+                                 "runstore.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rec(rs, scenario="s1", backend="cpu", value=84.0, ts="2026-08-01",
+         **kw):
+    return rs.make_record(scenario, {"luts": 60}, "nets_routed_per_sec",
+                          value, "nets/s", backend, "cpu",
+                          ts=ts, rev="abc1234", **kw)
+
+
+# ---- append/read round-trip ----
+
+def test_append_read_round_trip(tmp_path):
+    rs = _load()
+    runs = str(tmp_path / "runs")
+    r1 = _rec(rs, value=84.0, ts="2026-08-01",
+              qor={"wirelength": 537, "routed": True})
+    r2 = _rec(rs, value=85.5, ts="2026-08-02")
+    p = rs.append_run(runs, r1)
+    assert rs.append_run(runs, r2) == p
+    assert p.endswith(os.path.join("runs", "s1.jsonl"))
+    back = rs.read_runs(runs, "s1")
+    assert back == [r1, r2]          # oldest first, nothing lost
+    # one JSON object per line, append-only
+    with open(p) as f:
+        assert len(f.readlines()) == 2
+    assert rs.scenarios(runs) == ["s1"]
+    assert rs.read_runs(runs, "absent") == []
+
+
+def test_scenario_sanitization():
+    rs = _load()
+    assert rs.sanitize_scenario("scale0_l60_w12") == "scale0_l60_w12"
+    # path metacharacters can never escape runs/
+    assert "/" not in rs.sanitize_scenario("../../etc/passwd")
+    assert rs.sanitize_scenario("") == "unnamed"
+
+
+def test_config_hash_stable():
+    rs = _load()
+    a = rs.config_hash({"luts": 60, "batch": 64})
+    b = rs.config_hash({"batch": 64, "luts": 60})   # key order irrelevant
+    assert a == b and len(a) == 12
+    assert rs.config_hash({"luts": 61, "batch": 64}) != a
+
+
+# ---- schema floor ----
+
+def test_schema_rejection(tmp_path):
+    rs = _load()
+    runs = str(tmp_path / "runs")
+    good = _rec(rs)
+    for field in ("schema_version", "scenario", "value", "backend"):
+        bad = dict(good)
+        del bad[field]
+        assert rs.validate_record(bad)
+        with pytest.raises(ValueError):
+            rs.append_run(runs, bad)
+    # wrong types are rejected (bools are not numbers)
+    bad = dict(good, value="fast")
+    assert rs.validate_record(bad)
+    bad = dict(good, value=True)
+    assert rs.validate_record(bad)
+    # a reader refuses records from a NEWER schema than it understands
+    newer = dict(good, schema_version=rs.SCHEMA_VERSION + 1)
+    assert any("newer" in e for e in rs.validate_record(newer))
+    with pytest.raises(ValueError):
+        rs.make_record("s", {}, "m", "not-a-number", "u", "cpu", "cpu")
+
+
+def test_read_skips_invalid_lines_unless_strict(tmp_path):
+    rs = _load()
+    runs = str(tmp_path / "runs")
+    rs.append_run(runs, _rec(rs))
+    with open(rs.run_path(runs, "s1"), "a") as f:
+        f.write("not json\n")
+        f.write(json.dumps({"schema_version": 1}) + "\n")
+    assert len(rs.read_runs(runs, "s1")) == 1
+    with pytest.raises(ValueError):
+        rs.read_runs(runs, "s1", strict=True)
+
+
+# ---- trajectory filtering ----
+
+def test_latest_same_backend_filters():
+    rs = _load()
+    recs = [
+        _rec(rs, backend="cpu", value=30.0, ts="t1",
+             tags={"pre_pr2": True}),       # legacy era: excluded
+        _rec(rs, backend="tpu", value=90.0, ts="t2"),  # cross-backend
+        _rec(rs, backend="cpu", value=80.0, ts="t3"),
+        _rec(rs, backend="cpu", value=84.0, ts="t4"),
+        _rec(rs, backend="cpu", value=85.0, ts="t5"),  # the fresh row
+    ]
+    hist = rs.latest_same_backend(recs, "cpu", 5, exclude_ts="t5")
+    assert [r["ts"] for r in hist] == ["t3", "t4"]
+    assert rs.latest_same_backend(recs, "cpu", 1,
+                                  exclude_ts="t5")[0]["ts"] == "t4"
+    assert rs.latest_same_backend(recs, "rocm", 5) == []
+
+
+# ---- congestion heatmaps ----
+
+def test_node_points_span_tiles():
+    rs = _load()
+    # node 0: a 1-tile node at (2, 3); node 1: a wire spanning x 1..3
+    xlow, xhigh = [2, 1], [2, 3]
+    ylow, yhigh = [3, 5], [3, 5]
+    pts = rs.node_points([[0, 4], [1, 2]], xlow, ylow, xhigh, yhigh)
+    assert [2, 3, 4] in pts
+    # the length-3 wire contributes its overuse at each spanned tile
+    assert ([1, 5, 2] in pts and [2, 5, 2] in pts and [3, 5, 2] in pts)
+    assert len(pts) == 4
+    assert rs.node_points([], xlow, ylow, xhigh, yhigh) == []
+
+
+def test_rasterize_known_points():
+    rs = _load()
+    # 4x4 domain onto 2x2 bins: quadrants are unambiguous
+    hm = rs.rasterize([[0, 0, 1], [1, 1, 2], [3, 0, 5], [0, 3, 7],
+                       [3, 3, 11]], 4, 4, bins=2)
+    assert hm == [[3, 5], [7, 11]]
+    # out-of-range points clamp to edge bins rather than vanish
+    hm = rs.rasterize([[99, -5, 1]], 4, 4, bins=2)
+    assert hm[0][1] == 1
+
+
+def test_congestion_blob_round_trip():
+    rs = _load()
+    xlow = [0, 2]
+    xhigh = [0, 2]
+    ylow = [1, 3]
+    yhigh = [1, 3]
+    recs = [{"window": 0, "iteration": 1, "overused_nodes": 2,
+             "overuse_total": 5, "pres_fac": 0.5,
+             "top_overused": [[0, 3], [1, 2]]},
+            {"window": 1, "iteration": 2, "overused_nodes": 1,
+             "overuse_total": 2, "pres_fac": 0.65,
+             "top_overused": [[1, 2]]}]
+    blob = rs.congestion_blob(recs, xlow, ylow, xhigh, yhigh, 4, 4,
+                              bins=4)
+    assert blob["bins"] == 4 and blob["extent"] == [4, 4]
+    assert len(blob["windows"]) == 2
+    assert blob["windows"][0]["points"] == [[0, 1, 3], [2, 3, 2]]
+    # the aggregate raster sums every window's points
+    assert blob["heatmap"][1][0] == 3       # (x=0, y=1)
+    assert blob["heatmap"][3][2] == 4       # (x=2, y=3) from both windows
+    assert sum(map(sum, blob["heatmap"])) == 3 + 2 + 2
+    # JSON-serializable end to end (it rides inside a corpus record)
+    json.dumps(blob)
+    assert rs.congestion_blob([], xlow, ylow, xhigh, yhigh, 4, 4) is None

@@ -27,7 +27,18 @@ Checks, each skipped (with a note) when its artifact is not given:
            (default 10%), wirelength must not increase at all, the
            pipeline fill factor keeps a floor, the wasted-sweep
            fraction must not jump; keys missing from either row are
-           tolerated (older rows predate some riders)
+           tolerated (older rows predate some riders).  Rows from
+           DIFFERENT backends are never compared: the gate is skipped
+           with a warning (exit 0) — the r04/r05 CPU-fallback rows
+           were silently diffed against TPU rows once; never again
+  corpus   (--corpus [--scenario S] --runs-dir runs) gate the most
+           recent corpus row of each scenario against the MEDIAN of
+           the last --corpus-k same-backend rows of its trajectory
+           (runs/<scenario>.jsonl, see obs/runstore.py): the metric of
+           record keeps the --nets-tol floor and wirelength must not
+           exceed the trajectory median.  Cross-backend rows and
+           pre_pr2 imports never enter the median; a scenario with no
+           same-backend history skips with a note
 
 Exit codes: 0 healthy, 1 regression / broken invariant, 2 usage or
 unreadable artifact.
@@ -41,6 +52,7 @@ import importlib.util
 import json
 import math
 import os
+import statistics
 import sys
 
 # mirrors obs/devprof.py DELTA_BAND_LOG10 (stdlib-only: no repo import)
@@ -135,12 +147,39 @@ def check_devprof(path: str) -> tuple:
     return errs, notes
 
 
+def _load_runstore():
+    """obs/runstore.py by file path (same pattern as _load_sibling;
+    the corpus module is deliberately stdlib-only so the doctor stays
+    runnable without jax or the repo on sys.path)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "parallel_eda_tpu", "obs", "runstore.py")
+    spec = importlib.util.spec_from_file_location(
+        "runstore", os.path.normpath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _row_of(doc):
     """Accept either a driver capture ({"parsed": row, ...}) or a bare
     bench row ({"metric": ..., "value": ...})."""
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         return doc["parsed"]
     return doc if isinstance(doc, dict) else None
+
+
+def _row_backend(row) -> str:
+    """Backend a bench row ran on: the stamped top-level field (new
+    rows) falling back to detail.platform (older rows).  "" when the
+    row predates both — unknown backends are treated as comparable, so
+    the legacy history keeps gating itself."""
+    if not isinstance(row, dict):
+        return ""
+    be = row.get("backend")
+    if isinstance(be, str) and be:
+        return be
+    pl = (row.get("detail") or {}).get("platform")
+    return pl if isinstance(pl, str) else ""
 
 
 def latest_bench_rows(bench_dir: str, exclude: str = None) -> list:
@@ -215,6 +254,72 @@ def check_row(fresh: dict, prev: dict, nets_tol: float) -> tuple:
     return errs, notes
 
 
+def check_corpus_scenario(rs, records: list, nets_tol: float,
+                          k: int) -> tuple:
+    """Gate a scenario's most recent corpus record against the median
+    of the last ``k`` SAME-BACKEND rows of its trajectory.  Returns
+    (errors, notes).  No same-backend history (first run on this
+    backend, or only cross-backend / pre_pr2 rows behind it) is a
+    skip-note, not a failure — the corpus has to be allowed to grow."""
+    errs, notes = [], []
+    fresh = records[-1]
+    backend = _row_backend(fresh)
+    hist = rs.latest_same_backend(records[:-1], backend, k)
+    hist = [r for r in hist if r.get("metric") == fresh.get("metric")]
+    if not hist:
+        notes.append(f"no same-backend ({backend or '?'}) history; "
+                     f"corpus gate skipped")
+        return errs, notes
+    med = statistics.median(r["value"] for r in hist)
+    floor = (1.0 - nets_tol) * med
+    fv = fresh.get("value")
+    if fv < floor:
+        errs.append(f"{fresh.get('metric')} regressed: {fv} < "
+                    f"{floor:.4g} (= median of last {len(hist)} "
+                    f"{backend} row(s) {med:.4g} - {nets_tol:.0%})")
+    else:
+        notes.append(f"{fresh.get('metric')}: {fv} vs {backend} "
+                     f"trajectory median {med:.4g} "
+                     f"(floor {floor:.4g}) ok")
+    wls = [(r.get("qor") or {}).get("wirelength") for r in hist]
+    wls = [w for w in wls if isinstance(w, (int, float))]
+    fw = (fresh.get("qor") or {}).get("wirelength")
+    if isinstance(fw, (int, float)) and wls:
+        wmed = statistics.median(wls)
+        if fw > wmed:
+            errs.append(f"wirelength regressed: {fw} > trajectory "
+                        f"median {wmed:.4g} (any increase fails)")
+        else:
+            notes.append(f"wirelength: {fw} vs trajectory median "
+                         f"{wmed:.4g} ok")
+    else:
+        notes.append("wirelength missing from trajectory; gate skipped")
+    return errs, notes
+
+
+def check_corpus(runs_dir: str, scenario, nets_tol: float,
+                 k: int) -> tuple:
+    """Corpus-mode entry: gate one scenario (or, with scenario=None,
+    every scenario in the corpus).  Returns (errors, notes)."""
+    rs = _load_runstore()
+    names = [scenario] if scenario else rs.scenarios(runs_dir)
+    if not names:
+        return ([f"corpus: no scenarios under {runs_dir}/ (did the "
+                 f"bench append its row?)"], [])
+    errs, notes = [], []
+    for name in names:
+        records = rs.read_runs(runs_dir, name)
+        if not records:
+            errs.append(f"corpus[{name}]: no records "
+                        f"(missing or all-invalid "
+                        f"{rs.run_path(runs_dir, name)})")
+            continue
+        se, sn = check_corpus_scenario(rs, records, nets_tol, k)
+        errs += [f"corpus[{name}]: {e}" for e in se]
+        notes += [f"corpus[{name}]: {n}" for n in sn]
+    return errs, notes
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--trace", help="Chrome trace-event JSON to gate")
@@ -233,11 +338,25 @@ def main(argv=None) -> int:
     ap.add_argument("--nets-tol", type=float, default=NETS_PER_SEC_TOL,
                     help="allowed fractional drop in the row's metric "
                          "of record (default %(default)s)")
+    ap.add_argument("--corpus", action="store_true",
+                    help="gate the freshest corpus row of each "
+                         "scenario against its per-scenario "
+                         "trajectory (runs/<scenario>.jsonl)")
+    ap.add_argument("--runs-dir", default="runs",
+                    help="corpus directory for --corpus "
+                         "(default %(default)s)")
+    ap.add_argument("--scenario",
+                    help="restrict --corpus to one scenario "
+                         "(default: all)")
+    ap.add_argument("--corpus-k", type=int, default=5,
+                    help="trajectory window: median of the last K "
+                         "same-backend rows (default %(default)s)")
     args = ap.parse_args(argv)
 
-    if not any((args.trace, args.metrics, args.devprof, args.row)):
+    if not any((args.trace, args.metrics, args.devprof, args.row,
+                args.corpus)):
         ap.error("nothing to check: give at least one of --trace / "
-                 "--metrics / --devprof / --row")
+                 "--metrics / --devprof / --row / --corpus")
 
     errs, notes = [], []
     try:
@@ -270,10 +389,27 @@ def main(argv=None) -> int:
                         errs.append(f"row: previous {prev_path} is not "
                                     f"a bench row")
                     else:
-                        re_, rn = check_row(fresh, prev, args.nets_tol)
-                        errs += [f"row: {e}" for e in re_]
-                        notes += [f"row[{os.path.basename(prev_path)}]"
-                                  f": {n}" for n in rn]
+                        fb, pb = _row_backend(fresh), _row_backend(prev)
+                        if fb and pb and fb != pb:
+                            # cross-backend rows are not comparable
+                            # (the r04/r05 lesson): warn, don't gate
+                            notes.append(
+                                f"row: WARNING backends differ (fresh "
+                                f"{fb} vs previous {pb}); comparison "
+                                f"skipped — cross-backend rows are "
+                                f"not a trajectory")
+                        else:
+                            re_, rn = check_row(fresh, prev,
+                                                args.nets_tol)
+                            errs += [f"row: {e}" for e in re_]
+                            notes += [
+                                f"row[{os.path.basename(prev_path)}]"
+                                f": {n}" for n in rn]
+        if args.corpus:
+            ce, cn = check_corpus(args.runs_dir, args.scenario,
+                                  args.nets_tol, args.corpus_k)
+            errs += ce
+            notes += cn
     except (OSError, json.JSONDecodeError) as e:
         print(f"flow doctor: cannot read artifact: {e}",
               file=sys.stderr)
