@@ -194,6 +194,11 @@ def check_options(args) -> None:
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # multi-tenant route service subcommand (serve/cli.py): its own
+        # argparse surface — job queue, AOT program library, tenants
+        from .serve.cli import main as serve_main
+        return serve_main(argv[1:])
     for i, a in enumerate(argv):
         try:
             if a == "--settings_file":

@@ -34,7 +34,11 @@ import subprocess
 import time
 from typing import Optional
 
-SCHEMA_VERSION = 1
+# v2 adds OPTIONAL multi-tenant provenance: ``tenant`` / ``job_id``
+# string fields on records emitted by the route service
+# (serve/service.py).  Optional means v1 rows (and v2 writers with no
+# tenancy) stay valid — readers group by tenant only when present.
+SCHEMA_VERSION = 2
 
 # every corpus record must carry these, with these types — the schema
 # floor validate_record() rejects on.  Everything else (qor, gauges,
@@ -52,6 +56,10 @@ REQUIRED_FIELDS = (
     ("value", (int, float)),
     ("unit", str),
 )
+
+# optional string fields: validated for type when present, never
+# required (the v2 tenancy columns)
+OPTIONAL_STR_FIELDS = ("tenant", "job_id")
 
 _SCENARIO_OK = re.compile(r"[^A-Za-z0-9._-]+")
 
@@ -102,7 +110,9 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
                 tags: Optional[dict] = None,
                 ts: Optional[str] = None,
                 rev: Optional[str] = None,
-                repo_dir: Optional[str] = None) -> dict:
+                repo_dir: Optional[str] = None,
+                tenant: Optional[str] = None,
+                job_id: Optional[str] = None) -> dict:
     rec = {
         "schema_version": SCHEMA_VERSION,
         "ts": ts or now_iso(),
@@ -115,6 +125,10 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
         "value": float(value),
         "unit": str(unit),
     }
+    if tenant is not None:
+        rec["tenant"] = str(tenant)
+    if job_id is not None:
+        rec["job_id"] = str(job_id)
     for key, val in (("qor", qor), ("gauges", gauges),
                      ("series", series), ("congestion", congestion),
                      ("detail", detail), ("tags", tags)):
@@ -142,6 +156,10 @@ def validate_record(rec) -> list:
             errs.append(f"field {name!r} has type "
                         f"{type(rec[name]).__name__}, wanted "
                         f"{typ if isinstance(typ, type) else 'number'}")
+    for name in OPTIONAL_STR_FIELDS:
+        if name in rec and not isinstance(rec[name], str):
+            errs.append(f"field {name!r} has type "
+                        f"{type(rec[name]).__name__}, wanted str")
     sv = rec.get("schema_version")
     if isinstance(sv, int) and sv > SCHEMA_VERSION:
         errs.append(f"schema_version {sv} is newer than this reader's "

@@ -1514,12 +1514,20 @@ def _mis_colors(dev: DeviceRRGraph, occ, paths, all_reached,
     return rrm, color
 
 
+# the window program's static argnames — shared between the jit
+# decoration below and serve/library.py's AOT export split: a
+# jax.export'ed program BAKES its static values in, so the exported
+# call receives only the remaining (array) args, filtered by these
+# names against the function signature
+WINDOW_STATIC_ARGNAMES = ("K_iters", "nsweeps", "max_len", "num_waves",
+                          "group", "doubling", "topk", "n_colors",
+                          "mesh", "sta_depth", "crit_exp", "max_crit",
+                          "use_sdc", "use_pallas", "crop_tile")
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("K_iters", "nsweeps", "max_len", "num_waves",
-                     "group", "doubling", "topk", "n_colors", "mesh",
-                     "sta_depth", "crit_exp", "max_crit", "use_sdc",
-                     "use_pallas", "crop_tile"),
+    static_argnames=WINDOW_STATIC_ARGNAMES,
     donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
                      "bb", "crit_all"))
 def route_window_planes(

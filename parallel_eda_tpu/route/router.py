@@ -123,6 +123,14 @@ class RouterOpts:
     # (at window boundaries) into result.checkpoint — the elastic
     # resume surface (RouteCheckpoint; planes program only).  0 = off
     checkpoint_every: int = 0
+    # cooperative preemption (serve/ queue time-slicing): yield after
+    # >= this many NEW iterations this call — checkpoint at the next
+    # window boundary and return success=False + checkpoint.  Unlike
+    # shrinking max_router_iterations, this leaves the iteration budget
+    # (and therefore the per-window K clamp and the whole window
+    # partition) untouched, so a sliced negotiation resumed to the end
+    # is bit-identical to an unsliced run.  0 = off
+    slice_iterations: int = 0
     # bb-cropped planes relaxation (route.h:70-165 per-net boxes as a
     # static crop tile; planes.planes_relax_cropped): "auto" crops a
     # window whenever the bucketed tile is meaningfully smaller than
@@ -180,6 +188,14 @@ class RouterOpts:
     # source for the mdclog congestion records.  0 disables the
     # capture (mdclog records then carry an empty list)
     congestion_topk: int = 8
+    # AOT program library directory (serve/library.py): dispatch
+    # variants found in the library are served from deserialized
+    # jax.export executables — a fresh process routes its first window
+    # with ZERO compiles (route.dispatch.compiles == 0) — and unknown
+    # variants fall back to the jit path and are noted for
+    # Router.export_program_library().  None = off.  Single-device
+    # planes programs only (exported modules bake one partitioning)
+    program_library_dir: Optional[str] = None
 
 
 @dataclass
@@ -615,6 +631,25 @@ class Router:
         self._cap_np = None    # host capacity copy for congestion top-k
         if self.opts.compile_cache_dir:
             enable_persistent_compile_cache(self.opts.compile_cache_dir)
+        # AOT program library (serve/library.py): loaded keys are
+        # pre-registered as SEEN dispatch variants — a warm serve's
+        # first window is a cache hit, not a compile — and the library
+        # object serves those variants from deserialized executables
+        # at the dispatch site
+        self._library = None
+        if self.opts.program_library_dir and mesh is None \
+                and self.pg is not None:
+            from ..serve.library import ProgramLibrary
+            self._library = lib = ProgramLibrary(
+                self.opts.program_library_dir)
+            lib.load()
+            for key in lib.keys():
+                _DISPATCH_VARIANTS.add(key)
+            reg = get_metrics()
+            reg.gauge("route.serve.library_variants").set(
+                len(lib.keys()))
+            reg.gauge("route.serve.library_stale").set(
+                0 if lib.stale_reason is None else 1)
         self._s_batch = self._s_node = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -624,6 +659,18 @@ class Router:
             self._s_batch = NamedSharding(mesh, P(NET))
             self._s_node = NamedSharding(mesh, P(NODE))
             self._net_axis = mesh.shape[NET]
+
+    def export_program_library(self) -> int:
+        """Serialize every dispatch variant noted since the last save
+        into opts.program_library_dir (serve/library.py).  Pays one
+        trace+lower+compile per new variant — call after a warm-up
+        route(), never mid-serve.  Returns entries written."""
+        if self._library is None:
+            return 0
+        n = self._library.save()
+        get_metrics().gauge("route.serve.library_variants").set(
+            len(self._library.keys()))
+        return n
 
     @staticmethod
     def _dump_routes(stats_dir: str, it: int, paths: np.ndarray,
@@ -1072,6 +1119,14 @@ class Router:
         L_cap = self.max_len
         next_ckpt = (it_done + opts.checkpoint_every
                      if opts.checkpoint_every else None)
+        # cooperative yield target (slice_iterations): force a
+        # checkpoint at the slice edge even when checkpoint_every is off
+        yield_at = (it_done + opts.slice_iterations
+                    if opts.slice_iterations else None)
+        if yield_at is not None:
+            next_ckpt = (yield_at if next_ckpt is None
+                         else min(next_ckpt, yield_at))
+        sliced_yield = False
         # static initial bbs (terminal extent + bb_factor): the crop
         # anchor — tiles must cover a net's terminals even after its
         # LIVE bb widens device-side (see _step_core crop notes)
@@ -1272,11 +1327,11 @@ class Router:
                 # as a static arg or shape.  New key = a fresh XLA
                 # compile (or persistent-cache load); known key = a jit
                 # cache hit
-                _note_dispatch_variant(
-                    (tile, K, nsw, L, waves, grp_w, doubling,
-                     sel_p.shape[0], sel_p.shape[1], wok is None,
-                     self.use_pallas, self.mesh is not None,
-                     bool(sta_kw), R, Smax, N))
+                vkey = (tile, K, nsw, L, waves, grp_w, doubling,
+                        sel_p.shape[0], sel_p.shape[1], wok is None,
+                        self.use_pallas, self.mesh is not None,
+                        bool(sta_kw), R, Smax, N)
+                _note_dispatch_variant(vkey)
                 wp_args = (
                     self.pg, dev, occ, acc, paths, sink_delay,
                     all_reached, bb, source_d, sinks_d, crit_d,
@@ -1300,7 +1355,15 @@ class Router:
                 get_devprof().note_variant(
                     (tile, K, nsw, L, waves, grp_w), kplan,
                     route_window_planes, wp_args, wp_kwargs)
-                out = route_window_planes(*wp_args, **wp_kwargs)
+                if self._library is not None:
+                    # AOT library serve: known variants run from the
+                    # deserialized exported executable (no trace/
+                    # lower); misses note their avatarized args for
+                    # export_program_library() and take the jit path
+                    out = self._library.dispatch(
+                        vkey, route_window_planes, wp_args, wp_kwargs)
+                else:
+                    out = route_window_planes(*wp_args, **wp_kwargs)
                 # plan-shape ledger inputs: filled batch slots, plan
                 # width, and real (non-pad) batch rows of this dispatch
                 return out, (int(valid_p.sum()), valid_p.shape[1],
@@ -1649,6 +1712,13 @@ class Router:
                 next_ckpt = it_done + opts.checkpoint_every
                 mlog.log("elastic", event="checkpoint",
                          it_done=it_done, pres=round(pres, 4))
+                if yield_at is not None and it_done >= yield_at:
+                    # preemption yield: the checkpoint above is the
+                    # resume point; the unfinished result reports the
+                    # iterations actually spent this slice
+                    sliced_yield = True
+                    result.iterations = it_done
+                    break
         else:
             result.iterations = opts.max_router_iterations
 
@@ -1665,9 +1735,12 @@ class Router:
             reg.gauge("route.pipeline.host_plan_ms_total").set(round(
                 pl_tot_host * 1e3, 3))
 
-        if not result.success and fin_save is not None:
+        if not result.success and fin_save is not None \
+                and not sliced_yield:
             # the finishing pass could not re-legalize within budget:
-            # restore the pre-finish converged (legal) state
+            # restore the pre-finish converged (legal) state (a
+            # preemption yield instead keeps the in-finish state — the
+            # checkpoint carries fin_save and the resume finishes it)
             occ, paths, sink_delay, all_reached, bb, fin_it = fin_save
             result.success = True
             result.iterations = fin_it
@@ -1713,6 +1786,27 @@ class Router:
         if resume is not None and self.pg is None:
             raise ValueError("resume is supported by the planes program")
         opts = self.opts
+        # multi-route safety (the serve loop calls route() many times
+        # on one process): re-assert THIS router's persistent compile
+        # cache dir — another Router built since may have pointed the
+        # process-global cache elsewhere (no-op when unchanged) — and
+        # zero the per-route pipeline gauges so a job that never
+        # reaches a given gauge doesn't inherit the previous job's
+        # value.  The dispatch-variant seen-set is process state on
+        # purpose and is NOT reset: warm variants stay warm.
+        if opts.compile_cache_dir:
+            enable_persistent_compile_cache(opts.compile_cache_dir)
+        get_metrics().set_gauges({k: 0.0 for k in (
+            "route.pipeline.host_plan_ms",
+            "route.pipeline.device_exec_ms",
+            "route.pipeline.stall_ms",
+            "route.pipeline.overlap_frac",
+            "route.pipeline.host_overlap_frac",
+            "route.pipeline.host_plan_ms_total",
+            "route.pipeline.device_exec_ms_total",
+            "route.pipeline.stall_ms_total",
+            "route.pipeline.host_serial_ms_total",
+        )})
         # normalized into a LOCAL — never mutate the caller's
         # RouterOpts (the same opts object may drive several routers,
         # and the caller may compare it against what it passed in)

@@ -1,0 +1,22 @@
+"""Multi-tenant route serving: AOT program library, job queue,
+cross-job lane packing, and the RouteService front end.
+
+The subsystem treats the router like an inference server: admission
+(queue.py), warm program cache (library.py), cross-job batching
+(batcher.py), and the service loop + per-tenant telemetry
+(service.py).  Everything here layers ON TOP of route/ — no routing
+semantics live in this package, and per-job QoR is bit-identical to
+running the same job alone.
+"""
+
+from .library import ProgramLibrary
+from .queue import JobQueue, RouteJob, JobState
+from .batcher import CrossJobPlan, RungPlan, pack_jobs
+from .service import RouteService, ServeJobSpec
+
+__all__ = [
+    "ProgramLibrary",
+    "JobQueue", "RouteJob", "JobState",
+    "CrossJobPlan", "RungPlan", "pack_jobs",
+    "RouteService", "ServeJobSpec",
+]

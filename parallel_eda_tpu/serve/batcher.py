@@ -1,0 +1,156 @@
+"""Cross-job net bin-packing.
+
+Folds nets from multiple admitted jobs into shared size-class packed
+dispatches.  The lane-packed relaxation kernels (route/planes_pallas)
+are per-net: each net relaxes on its own folded canvas against its own
+congestion view, and packing is bit-identical for ANY block size G —
+so a packed batch mixing nets from different jobs computes, net for
+net, exactly what each job's solo batch computes.  The batcher's job
+is therefore pure bookkeeping: bin the UNION of all jobs' nets onto
+one size-class crop ladder (the same ``_size_class_buckets`` pow-2
+ladder the Router uses solo), plan one shared ``PackedLayout`` +
+``auto_block_nets`` G per populated rung, and demultiplex packed slots
+strictly back to (job, net) — a slot belongs to exactly one job, pad
+slots to none.
+
+The win is occupancy: two 15-LUT jobs half-filling a G=16 block solo
+share one full block batched, so the device sees fewer, fuller
+dispatches for the same total work.
+
+Inputs are plain numpy spans; no jax, no Router import.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.metrics import get_metrics
+
+
+@dataclass
+class RungPlan:
+    """One shared packed dispatch class: a crop tile (None = full
+    canvas), its folded layout, the VMEM-planned block size, and the
+    (job, net) slot assignment in dispatch order."""
+    tile: Optional[Tuple[int, int]]
+    shape_x: Tuple[int, int, int]
+    shape_y: Tuple[int, int, int]
+    block_nets: int
+    lane_occupancy: float
+    slots: List[Tuple[str, int]] = field(default_factory=list)
+
+    @property
+    def nets(self) -> int:
+        return len(self.slots)
+
+    @property
+    def blocks(self) -> int:
+        g = max(1, self.block_nets)
+        return (len(self.slots) + g - 1) // g
+
+    def demux(self) -> Dict[str, List[Tuple[int, int]]]:
+        """job_id -> [(packed_slot, job_net_idx)] — strict: every
+        occupied slot maps to exactly one job; pad slots (beyond
+        ``nets`` up to blocks*G) map to none."""
+        out: Dict[str, List[Tuple[int, int]]] = {}
+        for s, (job, idx) in enumerate(self.slots):
+            out.setdefault(job, []).append((s, idx))
+        return out
+
+
+@dataclass
+class CrossJobPlan:
+    rungs: List[RungPlan]
+    jobs: List[str]
+
+    @property
+    def total_nets(self) -> int:
+        return sum(r.nets for r in self.rungs)
+
+    def job_slots(self, job_id: str) -> List[Tuple[int, int, int]]:
+        """[(rung, packed_slot, job_net_idx)] for one job."""
+        out = []
+        for ri, r in enumerate(self.rungs):
+            for s, idx in r.demux().get(job_id, []):
+                out.append((ri, s, idx))
+        return out
+
+
+def pack_jobs(job_nets: Dict[str, Tuple[np.ndarray, np.ndarray]],
+              shape_x: Tuple[int, int, int],
+              shape_y: Tuple[int, int, int],
+              min_count: int = 1, base: int = 8,
+              lane_mult: Optional[int] = None,
+              publish_gauges: bool = True) -> CrossJobPlan:
+    """Plan shared packed dispatches for several jobs' nets.
+
+    ``job_nets`` maps job_id -> (need_w, need_h) per-net canvas spans
+    (grid cells, crop margin included — the same arrays the Router
+    feeds ``_size_class_buckets``).  ``shape_x``/``shape_y`` are the
+    full-canvas plane shapes (``pg.shape_x``/``pg.shape_y``); all jobs
+    must target the same device grid, which is what makes their
+    variant keys shareable in the first place.
+    """
+    from ..route.planes_pallas import (DEF_LANE_MULT, auto_block_nets,
+                                       packed_layout)
+    from ..route.router import _size_class_buckets
+
+    lm = DEF_LANE_MULT if lane_mult is None else lane_mult
+    W, NX, NYp1 = shape_x
+    _, NXp1, NY = shape_y
+    nx, ny = NX, NY
+
+    jobs = sorted(job_nets)
+    # union spans, with provenance back to (job, net)
+    owners: List[Tuple[str, int]] = []
+    need_w_all, need_h_all = [], []
+    for job in jobs:
+        nw, nh = job_nets[job]
+        nw = np.asarray(nw)
+        nh = np.asarray(nh)
+        if nw.shape != nh.shape:
+            raise ValueError(f"{job}: span arrays disagree "
+                             f"{nw.shape} vs {nh.shape}")
+        for i in range(len(nw)):
+            owners.append((job, i))
+        need_w_all.append(nw)
+        need_h_all.append(nh)
+    if not owners:
+        return CrossJobPlan(rungs=[], jobs=jobs)
+    need_w = np.concatenate(need_w_all)
+    need_h = np.concatenate(need_h_all)
+
+    classes, assign = _size_class_buckets(
+        need_w, need_h, nx, ny, min_count=min_count, base=base)
+
+    rungs: List[RungPlan] = []
+    for k, tile in enumerate(list(classes) + [None]):
+        idx = np.nonzero(assign == k)[0]
+        if len(idx) == 0:
+            continue
+        if tile is not None:
+            cnx, cny = tile
+            shx, shy = (W, cnx, cny + 1), (W, cnx + 1, cny)
+        else:
+            shx, shy = (W, NX, NYp1), (W, NXp1, NY)
+        lay = packed_layout(shx, shy, lane_mult=lm)
+        g = auto_block_nets(shx, shy, len(idx), lane_mult=lm)
+        rungs.append(RungPlan(
+            tile=tile, shape_x=shx, shape_y=shy, block_nets=g,
+            lane_occupancy=round(lay.lane_occupancy(g), 4),
+            slots=[owners[i] for i in idx]))
+
+    plan = CrossJobPlan(rungs=rungs, jobs=jobs)
+    if publish_gauges and rungs:
+        occ = (sum(r.lane_occupancy * r.nets for r in rungs)
+               / max(1, plan.total_nets))
+        get_metrics().set_gauges({
+            "route.serve.pack.jobs": len(jobs),
+            "route.serve.pack.shared_rungs": len(rungs),
+            "route.serve.pack.nets": plan.total_nets,
+            "route.serve.pack.lane_occupancy": round(occ, 4),
+        })
+    return plan

@@ -1,0 +1,168 @@
+"""Job queue for the route service.
+
+Cooperative (single-threaded) scheduling: the routing device is one
+serially-ordered resource, so the queue time-slices it rather than
+spawning threads — a job runs for a bounded slice of router
+iterations, gets checkpointed via the existing ``RouteCheckpoint``
+resume path, and goes back in the heap.  That gives preemption,
+priority ordering, per-job deadlines, and bounded retry-with-backoff
+without any routing-semantics changes: a preempted-and-resumed job
+computes exactly what an uninterrupted one does.
+
+The queue knows nothing about routing.  The runner callback owns the
+domain: it receives a ``RouteJob`` and returns one of
+
+    ("done", result)           — job finished
+    ("preempted", checkpoint)  — slice expired; requeue with state
+    ("failed", message)        — attempt failed; retry or bury
+
+A raised exception counts as a failed attempt.  service.py provides
+the Router-backed runner; tests drive the queue with fakes.
+
+Stdlib + obs.metrics only.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs.metrics import get_metrics
+
+
+class JobState(Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+    TIMEOUT = "timeout"
+
+
+@dataclass
+class RouteJob:
+    tenant: str
+    payload: Any                       # opaque to the queue
+    job_id: str = ""
+    priority: int = 0                  # higher runs first
+    deadline_s: Optional[float] = None # wall budget from admit()
+    max_retries: int = 0
+    backoff_s: float = 0.05
+    backoff_mult: float = 2.0
+    state: JobState = JobState.QUEUED
+    attempts: int = 0
+    preemptions: int = 0
+    slices: int = 0
+    checkpoint: Any = None             # RouteCheckpoint between slices
+    result: Any = None
+    error: Optional[str] = None
+    admitted_t: float = 0.0
+    not_before: float = 0.0            # backoff gate
+    scratch: Dict[str, Any] = field(default_factory=dict)
+
+    def deadline_exceeded(self, now: float) -> bool:
+        return (self.deadline_s is not None
+                and now - self.admitted_t > self.deadline_s)
+
+
+Outcome = Tuple[str, Any]
+Runner = Callable[[RouteJob], Outcome]
+
+
+class JobQueue:
+    """Priority heap + cooperative run loop."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._heap: List[Tuple[int, int, RouteJob]] = []
+        self._seq = 0
+        self._clock = clock
+        self.jobs: List[RouteJob] = []
+
+    # ------------------------------------------------------ admit
+
+    def admit(self, job: RouteJob) -> RouteJob:
+        if not job.job_id:
+            job.job_id = f"job{len(self.jobs):04d}"
+        job.admitted_t = self._clock()
+        job.state = JobState.QUEUED
+        self.jobs.append(job)
+        self._push(job)
+        get_metrics().counter("route.serve.jobs_admitted").inc()
+        self._depth_gauge()
+        return job
+
+    def _push(self, job: RouteJob) -> None:
+        # fresh seq on every (re)queue: equal-priority jobs round-robin
+        # between slices instead of one job monopolizing the device
+        self._seq += 1
+        heapq.heappush(self._heap, (-job.priority, self._seq, job))
+
+    def _depth_gauge(self) -> None:
+        get_metrics().gauge("route.serve.queue_depth").set(
+            len(self._heap))
+
+    def depth(self) -> int:
+        return len(self._heap)
+
+    # -------------------------------------------------------- run
+
+    def run(self, runner: Runner,
+            max_slices: int = 100000) -> List[RouteJob]:
+        """Drain the queue through ``runner``; returns all jobs in
+        admission order with terminal states set."""
+        m = get_metrics()
+        slices = 0
+        while self._heap and slices < max_slices:
+            slices += 1
+            _, _, job = heapq.heappop(self._heap)
+            self._depth_gauge()
+            now = self._clock()
+            if job.deadline_exceeded(now):
+                job.state = JobState.TIMEOUT
+                job.error = (f"deadline {job.deadline_s}s exceeded "
+                             f"after {now - job.admitted_t:.2f}s")
+                m.counter("route.serve.jobs_timeout").inc()
+                continue
+            if now < job.not_before:
+                # backoff not elapsed; if it's the only job, wait it out
+                self._push(job)
+                if all(self._clock() < j.not_before
+                       for _, _, j in self._heap):
+                    time.sleep(max(0.0, job.not_before - self._clock()))
+                continue
+            job.state = JobState.RUNNING
+            job.slices += 1
+            try:
+                verdict, value = runner(job)
+            except Exception as e:  # an attempt died; retry or bury
+                verdict, value = "failed", f"{type(e).__name__}: {e}"
+            if verdict == "done":
+                job.state = JobState.DONE
+                job.result = value
+                m.counter("route.serve.jobs_done").inc()
+            elif verdict == "preempted":
+                job.checkpoint = value
+                job.preemptions += 1
+                job.state = JobState.QUEUED
+                m.counter("route.serve.jobs_preempted").inc()
+                self._push(job)
+            elif verdict == "failed":
+                job.attempts += 1
+                job.error = str(value)
+                if job.attempts > job.max_retries:
+                    job.state = JobState.FAILED
+                    m.counter("route.serve.jobs_failed").inc()
+                else:
+                    back = job.backoff_s * (
+                        job.backoff_mult ** (job.attempts - 1))
+                    job.not_before = self._clock() + back
+                    job.checkpoint = None  # retry restarts clean
+                    job.state = JobState.QUEUED
+                    m.counter("route.serve.jobs_retried").inc()
+                    self._push(job)
+            else:
+                raise ValueError(f"runner returned {verdict!r}")
+            self._depth_gauge()
+        return list(self.jobs)

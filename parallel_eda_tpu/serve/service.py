@@ -1,0 +1,193 @@
+"""RouteService: the multi-tenant serving front end.
+
+One Router (one device graph, one warm program cache) serves many
+admitted jobs: the queue time-slices the device between jobs via the
+RouteCheckpoint resume path, the AOT program library keeps every
+dispatch variant warm across jobs AND processes, and the cross-job
+batcher publishes the shared packed-dispatch plan for the admitted
+set.  Per job the service verifies legality, publishes per-tenant
+``route.serve.*`` telemetry, and appends a tenant-stamped record to
+the observatory corpus.
+
+All jobs must target the same device graph (same arch/grid/channel
+width) — that is what makes their dispatch variants and packed layouts
+shareable; admit() enforces it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs.metrics import get_metrics
+from ..route.router import Router, RouterOpts
+from .batcher import pack_jobs
+from .queue import JobQueue, JobState, RouteJob
+
+
+@dataclass
+class ServeJobSpec:
+    """One admitted routing request: terminals on the service's
+    device graph, plus accounting identity."""
+    term: Any                       # NetTerminals
+    name: str = ""
+    max_iterations: int = 0         # 0 = the service default
+    crit: Optional[np.ndarray] = None
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+
+class RouteService:
+    def __init__(self, rr, opts: Optional[RouterOpts] = None,
+                 slice_iters: int = 0, verify: bool = True,
+                 runs_dir: Optional[str] = None,
+                 scenario: str = "serve_smoke",
+                 cfg: Optional[dict] = None):
+        """``slice_iters`` > 0 preempts each job after that many router
+        iterations (checkpointed, requeued) — the fairness knob; 0
+        runs each job to completion in one slice."""
+        self.rr = rr
+        self.base_opts = opts or RouterOpts()
+        self.router = Router(rr, self.base_opts)
+        self.slice_iters = int(slice_iters)
+        self.verify = verify
+        self.runs_dir = runs_dir
+        self.scenario = scenario
+        self.cfg = dict(cfg or {})
+        self.queue = JobQueue()
+        self._t_init = time.perf_counter()
+        self._first_slice_s: Optional[float] = None
+
+    # ------------------------------------------------------- admit
+
+    def admit(self, spec: ServeJobSpec, tenant: str = "default",
+              priority: int = 0, deadline_s: Optional[float] = None,
+              max_retries: int = 0, job_id: str = "") -> RouteJob:
+        R, _ = spec.term.sinks.shape
+        if R and int(spec.term.source.max()) >= self.rr.num_nodes:
+            raise ValueError(
+                f"job {spec.name or job_id}: terminals reference node "
+                f"{int(spec.term.source.max())} outside this service's "
+                f"graph (num_nodes={self.rr.num_nodes}) — all jobs "
+                f"must target the same device")
+        job = RouteJob(tenant=tenant, payload=spec, job_id=job_id,
+                       priority=priority, deadline_s=deadline_s,
+                       max_retries=max_retries)
+        self.queue.admit(job)
+        self._publish_pack_plan()
+        return job
+
+    def _publish_pack_plan(self):
+        """Shared packed-dispatch plan over every queued job (batcher
+        telemetry: how the admitted set folds onto one crop ladder)."""
+        pg = self.router.pg
+        if pg is None:
+            return
+        Lm = pg.max_span
+        job_nets = {}
+        for job in self.queue.jobs:
+            if job.state not in (JobState.QUEUED, JobState.RUNNING):
+                continue
+            t = job.payload.term
+            job_nets[job.job_id] = (
+                (t.bb_xmax - t.bb_xmin + 1 + 2 * Lm).astype(np.int64),
+                (t.bb_ymax - t.bb_ymin + 1 + 2 * Lm).astype(np.int64))
+        if job_nets:
+            pack_jobs(job_nets, pg.shape_x, pg.shape_y)
+
+    # ------------------------------------------------------ runner
+
+    def _runner(self, job: RouteJob):
+        spec = job.payload
+        total = spec.max_iterations or self.base_opts.max_router_iterations
+        ck = job.checkpoint
+        # slice via RouterOpts.slice_iterations (cooperative yield at a
+        # window boundary), NOT by shrinking max_router_iterations —
+        # the iteration budget feeds the router's per-window K clamp,
+        # so capping it would change the window partition and with it
+        # the QoR.  The yield path leaves window planning untouched:
+        # sliced-and-resumed == unsliced, bit for bit.
+        self.router.opts = replace(
+            self.base_opts, max_router_iterations=total,
+            slice_iterations=max(0, self.slice_iters))
+        t0 = time.perf_counter()
+        res = self.router.route(spec.term, crit=spec.crit, resume=ck)
+        dt = time.perf_counter() - t0
+        if self._first_slice_s is None:
+            self._first_slice_s = time.perf_counter() - self._t_init
+            get_metrics().gauge("route.serve.warm_start_s").set(
+                round(self._first_slice_s, 3))
+        job.scratch["route_s"] = job.scratch.get("route_s", 0.0) + dt
+        if res.success:
+            return "done", self._finish(job, res)
+        ck2 = res.checkpoint
+        prev_it = ck.it_done if ck is not None else 0
+        if (ck2 is not None and ck2.it_done < total
+                and ck2.it_done > prev_it):
+            # made progress and the budget isn't exhausted: requeue
+            return "preempted", ck2
+        return "failed", f"unroutable within {total} iterations"
+
+    def _finish(self, job: RouteJob, res) -> dict:
+        spec = job.payload
+        term = spec.term
+        if self.verify:
+            from ..route.check import check_route
+            check_route(self.rr, term, res.paths, occ=res.occ)
+        R = len(term.source)
+        wall = job.scratch.get("route_s", 0.0)
+        nets_per_s = R / max(wall, 1e-9)
+        m = get_metrics()
+        t = job.tenant
+        m.counter(f"route.serve.tenant.{t}.jobs_done").inc()
+        m.set_gauges({
+            f"route.serve.tenant.{t}.nets_per_s": round(nets_per_s, 3),
+            f"route.serve.tenant.{t}.wirelength": res.wirelength,
+            f"route.serve.tenant.{t}.iterations": res.iterations,
+        })
+        summary = dict(
+            job_id=job.job_id, tenant=t, name=spec.name,
+            success=res.success, wirelength=res.wirelength,
+            iterations=res.iterations, nets=R,
+            route_s=round(wall, 4), nets_per_s=round(nets_per_s, 3),
+            preemptions=job.preemptions, slices=job.slices,
+            result=res)
+        if self.runs_dir:
+            self._corpus_row(job, res, nets_per_s)
+        return summary
+
+    def _corpus_row(self, job: RouteJob, res, nets_per_s: float):
+        import jax
+
+        from ..obs.runstore import append_run, make_record
+        spec = job.payload
+        dev = jax.devices()[0]
+        rec = make_record(
+            scenario=self.scenario,
+            cfg={**self.cfg, "job": spec.name, "tenant": job.tenant},
+            metric="nets_per_s", value=nets_per_s, unit="nets/s",
+            backend=jax.default_backend(),
+            device_kind=getattr(dev, "device_kind", str(dev)),
+            qor=dict(wirelength=int(res.wirelength),
+                     iterations=int(res.iterations),
+                     success=bool(res.success)),
+            gauges=get_metrics().values("route.serve."),
+            detail=dict(preemptions=job.preemptions,
+                        slices=job.slices, **spec.detail),
+            tenant=job.tenant, job_id=job.job_id)
+        append_run(self.runs_dir, rec)
+
+    # --------------------------------------------------------- run
+
+    def run(self) -> List[RouteJob]:
+        """Drain the queue; returns all jobs with terminal states."""
+        t0 = time.perf_counter()
+        jobs = self.queue.run(self._runner)
+        wall = time.perf_counter() - t0
+        done = [j for j in jobs if j.state == JobState.DONE]
+        nets = sum(len(j.payload.term.source) for j in done)
+        get_metrics().gauge("route.serve.aggregate_nets_per_s").set(
+            round(nets / max(wall, 1e-9), 3))
+        return jobs

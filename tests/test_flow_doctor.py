@@ -284,6 +284,36 @@ def test_corpus_wirelength_regression_fails(tmp_path):
     assert any("wirelength" in e for e in errs)
 
 
+def test_corpus_tenant_rows_gate_per_job(tmp_path):
+    """A multi-tenant serve scenario carries one row PER JOB: the gate
+    must compare each (tenant, job_id) against ITS OWN trajectory —
+    job A's wirelength vs job B's median would be noise (the jobs
+    route different circuits)."""
+    fd = _load()
+    rs = fd._load_runstore()
+    runs = str(tmp_path / "runs")
+    # interleaved rows of two jobs: wl 89 job keeps finishing after
+    # the wl 97 job — ungrouped, 97 > median(89, 97) would fail
+    for i, (ten, jid, wl) in enumerate([
+            ("t0", "j0", 89), ("t1", "j1", 97),
+            ("t0", "j0", 89), ("t1", "j1", 97)]):
+        rs.append_run(runs, rs.make_record(
+            "serve_x", {"luts": 15}, "nets_per_s", 12.0, "nets/s",
+            "cpu", "cpu", qor={"wirelength": wl},
+            tenant=ten, job_id=jid, ts=f"t{i}", rev="abc1234"))
+    errs, notes = fd.check_corpus(runs, "serve_x", 0.10, 5)
+    assert errs == [], errs
+    assert any("serve_x:t0/j0" in n for n in notes)
+    assert any("serve_x:t1/j1" in n for n in notes)
+    # a genuine per-job wirelength regression still fails
+    rs.append_run(runs, rs.make_record(
+        "serve_x", {"luts": 15}, "nets_per_s", 12.0, "nets/s",
+        "cpu", "cpu", qor={"wirelength": 95},
+        tenant="t0", job_id="j0", ts="t9", rev="abc1234"))
+    errs, _ = fd.check_corpus(runs, "serve_x", 0.10, 5)
+    assert any("t0/j0" in e and "wirelength" in e for e in errs)
+
+
 def test_corpus_cross_backend_and_legacy_never_gate(tmp_path):
     """A fresh cpu row whose only history is tpu rows (or pre_pr2
     imports) has no trajectory: skip-note, no error — cross-backend

@@ -265,6 +265,31 @@ def test_checkpoint_resume_drains_pipeline():
 
 
 @pytest.mark.slow
+def test_queue_preemption_resumes_to_identical_route():
+    """The drain gate, driven by the serve-layer job queue: a job that
+    is repeatedly preempted (checkpointed mid-negotiation, requeued,
+    resumed) must land on a legal route with the SAME wirelength and
+    iteration count as routing the job solo in one shot."""
+    from parallel_eda_tpu.flow import synth_flow
+    from parallel_eda_tpu.serve import JobState, RouteService, ServeJobSpec
+    f = synth_flow(num_luts=40, num_inputs=8, num_outputs=8,
+                   chan_width=12, seed=3)
+    solo = Router(f.rr, RouterOpts(batch_size=32)).route(f.term)
+    assert solo.success
+
+    svc = RouteService(f.rr, RouterOpts(batch_size=32), slice_iters=2)
+    job = svc.admit(ServeJobSpec(term=f.term, name="drain"), tenant="t0")
+    svc.run()
+    assert job.state is JobState.DONE
+    assert job.preemptions >= 1 and job.slices == job.preemptions + 1
+    res = job.result["result"]
+    assert job.result["wirelength"] == solo.wirelength
+    assert res.iterations == solo.iterations
+    assert np.array_equal(res.paths, solo.paths)
+    check_route(f.rr, f.term, res.paths, occ=res.occ)
+
+
+@pytest.mark.slow
 def test_trace_spans_overlap_pipelined_only():
     """The emitted route.pipeline.{plan,exec} spans satisfy the same
     invariant trace_report --check enforces: plan time overlaps device

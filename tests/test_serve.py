@@ -1,0 +1,404 @@
+"""Multi-tenant route service (parallel_eda_tpu/serve/).
+
+Four layers, matching the subsystem:
+
+* library — AOT export/reload round trip: a "fresh process" (variant
+  seen-set + metrics cleared) serves every window from deserialized
+  executables with ``route.dispatch.compiles == 0`` and BIT-identical
+  results vs the jit path; provenance mismatch degrades gracefully to
+  jit.
+* queue — priorities, deadlines, retry-with-backoff, preemption
+  round-robin, all against fake runners/clocks (no jax).
+* batcher — strict per-job demux of the shared packed plan, and the
+  cross-job claim itself: a packed relaxation batch mixing two jobs'
+  nets equals each job's solo batch bit-for-bit (interpret mode).
+* service — two tenants through the queue with preemption slices:
+  per-job wirelength identical to solo, legal, tenant-stamped corpus
+  rows and route.serve.* telemetry.
+
+    python -m pytest tests/ -m serve
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from parallel_eda_tpu.obs import MetricsRegistry, get_metrics, set_metrics
+from parallel_eda_tpu.route import Router, RouterOpts, check_route
+from parallel_eda_tpu.route import router as router_mod
+from parallel_eda_tpu.serve.batcher import pack_jobs
+from parallel_eda_tpu.serve.queue import JobQueue, JobState, RouteJob
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    set_metrics(MetricsRegistry())
+    yield
+    set_metrics(MetricsRegistry())
+
+
+# ---- queue (no jax) ------------------------------------------------
+
+def _job(tenant="t", priority=0, **kw):
+    return RouteJob(tenant=tenant, payload=None, priority=priority, **kw)
+
+
+def test_queue_priority_order():
+    q = JobQueue()
+    lo = q.admit(_job(priority=0))
+    hi = q.admit(_job(priority=5))
+    mid = q.admit(_job(priority=2))
+    ran = []
+
+    def runner(job):
+        ran.append(job.job_id)
+        return "done", None
+
+    q.run(runner)
+    assert ran == [hi.job_id, mid.job_id, lo.job_id]
+    assert all(j.state == JobState.DONE for j in (lo, mid, hi))
+    v = get_metrics().values("route.serve.")
+    assert v["route.serve.jobs_admitted"] == 3
+    assert v["route.serve.jobs_done"] == 3
+
+
+def test_queue_deadline_timeout():
+    now = [0.0]
+    q = JobQueue(clock=lambda: now[0])
+    ok = q.admit(_job(deadline_s=10.0))
+    late = q.admit(_job(deadline_s=1.0))
+
+    def runner(job):
+        if job.preemptions == 0:
+            now[0] += 2.0       # each first slice costs 2s of fake wall
+            return "preempted", f"ck-{job.job_id}"
+        return "done", None
+
+    q.run(runner)
+    # `late` blows its 1s deadline at the re-slice check; `ok` finishes
+    assert ok.state == JobState.DONE
+    assert late.state == JobState.TIMEOUT
+    assert "deadline" in late.error
+    assert get_metrics().values(
+        "route.serve.")["route.serve.jobs_timeout"] == 1
+
+
+def test_queue_retry_backoff_then_failed():
+    q = JobQueue()
+    job = q.admit(_job(max_retries=2, backoff_s=0.001))
+    attempts = []
+
+    def runner(j):
+        attempts.append(j.checkpoint)   # retries restart clean
+        raise RuntimeError("device fell over")
+
+    q.run(runner)
+    assert job.state == JobState.FAILED
+    assert job.attempts == 3            # initial + 2 retries
+    assert attempts == [None, None, None]
+    assert "device fell over" in job.error
+    v = get_metrics().values("route.serve.")
+    assert v["route.serve.jobs_retried"] == 2
+    assert v["route.serve.jobs_failed"] == 1
+
+
+def test_queue_preemption_round_robin():
+    q = JobQueue()
+    a = q.admit(_job())
+    b = q.admit(_job())
+    trace = []
+
+    def runner(job):
+        trace.append(job.job_id)
+        if job.preemptions < 2:
+            return "preempted", f"ck{len(trace)}"
+        return "done", None
+
+    q.run(runner)
+    # equal priority: slices interleave instead of one job hogging
+    assert trace == [a.job_id, b.job_id] * 3
+    assert a.preemptions == b.preemptions == 2
+    assert a.checkpoint is not None     # last checkpoint retained
+    assert get_metrics().values(
+        "route.serve.")["route.serve.jobs_preempted"] == 4
+
+
+# ---- batcher -------------------------------------------------------
+
+def test_batcher_strict_demux():
+    rng = np.random.default_rng(0)
+    job_nets = {
+        "jobA": (rng.integers(4, 12, 9), rng.integers(4, 12, 9)),
+        "jobB": (rng.integers(4, 30, 5), rng.integers(4, 30, 5)),
+    }
+    plan = pack_jobs(job_nets, (6, 20, 17), (6, 21, 16))
+    # every (job, net) lands in exactly one packed slot
+    seen = {}
+    for ri, rung in enumerate(plan.rungs):
+        assert rung.block_nets >= 1
+        for slot, (job, idx) in enumerate(rung.slots):
+            assert (job, idx) not in seen
+            seen[(job, idx)] = (ri, slot)
+    assert len(seen) == 14 == plan.total_nets
+    # demux agrees with the forward map, job by job
+    for job, n in (("jobA", 9), ("jobB", 5)):
+        slots = plan.job_slots(job)
+        assert sorted(idx for _, _, idx in slots) == list(range(n))
+        for ri, s, idx in slots:
+            assert seen[(job, idx)] == (ri, s)
+    v = get_metrics().values("route.serve.pack.")
+    assert v["route.serve.pack.jobs"] == 2
+    assert v["route.serve.pack.nets"] == 14
+    assert v["route.serve.pack.shared_rungs"] == len(plan.rungs)
+
+
+def test_batcher_cross_job_relax_parity():
+    """Folding two jobs' nets into ONE packed relaxation batch changes
+    nothing, net for net: canvases are per-net, so the packed kernel is
+    job-agnostic — the property that makes cross-job lane packing
+    QoR-neutral by construction."""
+    from parallel_eda_tpu.arch.builtin import minimal_arch
+    from parallel_eda_tpu.route.planes_pallas import (auto_block_nets,
+                                                      planes_relax_pallas)
+    from tests.test_kernel_pack import _assert_identical, _instance
+
+    arch = minimal_arch(chan_width=6)
+    _, pg, d0, cc, crit, w0 = _instance(arch, 4, 4, 7, seed=11)
+    # nets 0..2 belong to job A, 3..6 to job B (same device graph)
+    slA, slB = slice(0, 3), slice(3, 7)
+    soloA = planes_relax_pallas(pg, d0[slA], cc[slA], crit[slA],
+                                w0[slA], 12, interpret=True,
+                                block_nets=1, lane_mult=1)
+    soloB = planes_relax_pallas(pg, d0[slB], cc[slB], crit[slB],
+                                w0[slB], 12, interpret=True,
+                                block_nets=1, lane_mult=1)
+    G = auto_block_nets(pg.shape_x, pg.shape_y, 7)
+    shared = planes_relax_pallas(pg, d0, cc, crit, w0, 12,
+                                 interpret=True, block_nets=G,
+                                 lane_mult=8)
+    # stats (index 2+) are per-dispatch maxima, not per-net — compare
+    # the per-net outputs (dist, winner)
+    _assert_identical([np.asarray(shared[0])[slA],
+                       np.asarray(shared[1])[slA]],
+                      [soloA[0], soloA[1]])
+    _assert_identical([np.asarray(shared[0])[slB],
+                       np.asarray(shared[1])[slB]],
+                      [soloB[0], soloB[1]])
+
+
+# ---- runstore v2 + observatory tenant grouping ---------------------
+
+def test_runstore_v2_tenant_fields(tmp_path):
+    import parallel_eda_tpu.obs.runstore as rs
+    rec = rs.make_record("serve_t", {"a": 1}, "nets_per_s", 10.0,
+                         "nets/s", "cpu", "cpu0", tenant="acme",
+                         job_id="job0001")
+    assert rec["schema_version"] == rs.SCHEMA_VERSION == 2
+    assert rec["tenant"] == "acme" and rec["job_id"] == "job0001"
+    assert rs.validate_record(rec) == []
+    # rows without tenancy (v1-era and single-tenant v2) stay valid
+    legacy = {k: v for k, v in rec.items()
+              if k not in ("tenant", "job_id")}
+    legacy["schema_version"] = 1
+    assert rs.validate_record(legacy) == []
+    # present-but-mistyped tenancy is rejected
+    bad = dict(rec, tenant=7)
+    assert any("tenant" in e for e in rs.validate_record(bad))
+    rs.append_run(str(tmp_path), rec)
+    assert rs.read_runs(str(tmp_path), "serve_t")[0]["tenant"] == "acme"
+
+
+def test_observatory_groups_by_tenant(tmp_path, capsys):
+    import parallel_eda_tpu.obs.runstore as rs
+    spec = importlib.util.spec_from_file_location(
+        "observatory", os.path.join(REPO, "tools", "observatory.py"))
+    obs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs)
+    for tenant, job, val in (("acme", "j1", 10.0), ("beta", "j2", 9.0),
+                             ("acme", "j3", 11.0)):
+        rs.append_run(str(tmp_path), rs.make_record(
+            "serve_t", {"a": 1}, "nets_per_s", val, "nets/s", "cpu",
+            "cpu0", tenant=tenant, job_id=job,
+            qor={"wirelength": 100, "iterations": 9}))
+    # an untenanted scenario keeps the flat table
+    rs.append_run(str(tmp_path), rs.make_record(
+        "plain", {"b": 2}, "nets_per_s", 5.0, "nets/s", "cpu", "cpu0"))
+    assert obs.print_report(rs, str(tmp_path)) == 0
+    out = capsys.readouterr().out
+    assert "### tenant acme  (2 run(s))" in out
+    assert "### tenant beta  (1 run(s))" in out
+    assert " j1 |" in out and " j2 |" in out
+    # the flat scenario has no tenant sub-headers (bound the slice at the
+    # next scenario header — sections are emitted in sorted order)
+    plain = out.split("## plain")[1].split("\n## ")[0]
+    assert "### tenant" not in plain
+
+
+# ---- AOT program library -------------------------------------------
+
+def test_library_static_split():
+    """The exported call must receive the dynamic args ONLY — statics
+    are baked in at export time (passing them is a pytree mismatch)."""
+    from parallel_eda_tpu.route.planes import (WINDOW_STATIC_ARGNAMES,
+                                               route_window_planes)
+    from parallel_eda_tpu.serve import library as lib
+
+    names = lib._positional_names(route_window_planes)
+    # the constant matches the live signature
+    assert set(WINDOW_STATIC_ARGNAMES) <= set(names)
+    args = tuple(f"v_{n}" for n in names)
+    kwargs = {"use_pallas": True, "crop_tile": (8, 8), "bb0_all": "bb0"}
+    dyn_args, dyn_kwargs = lib._split_dynamic(
+        route_window_planes, args, kwargs)
+    assert len(dyn_args) == len(names) - sum(
+        1 for n in names if n in WINDOW_STATIC_ARGNAMES)
+    assert not any(f"v_{s}" in dyn_args for s in WINDOW_STATIC_ARGNAMES)
+    assert dyn_kwargs == {"bb0_all": "bb0"}   # statics dropped
+
+
+def test_library_provenance_mismatch_degrades_to_jit(tmp_path):
+    import jax
+
+    from parallel_eda_tpu.serve.library import (INDEX_NAME,
+                                                ProgramLibrary,
+                                                _provenance)
+    lib_dir = tmp_path / "lib"
+    lib_dir.mkdir()
+    prov = _provenance()
+    prov["jaxlib"] = "0.0.0-other"
+    (lib_dir / "deadbeef.jexp").write_bytes(b"not a real module")
+    (lib_dir / INDEX_NAME).write_text(json.dumps({
+        "provenance": prov,
+        "entries": {"deadbeef": {"key": [1], "file": "deadbeef.jexp"}},
+    }))
+    lib = ProgramLibrary(str(lib_dir))
+    assert lib.load() == 0
+    assert "provenance_mismatch:jaxlib" in lib.stale_reason
+    # dispatch falls through to the live function (counted as fallback)
+    fn = jax.jit(lambda x: x + 1)
+    out = lib.dispatch(("k",), fn, (jax.numpy.ones(3),), {})
+    assert np.allclose(np.asarray(out), 2.0)
+    v = get_metrics().values("route.serve.")
+    assert v["route.serve.jit_fallbacks"] == 1
+    assert "route.serve.aot_hits" not in v
+
+
+def test_library_roundtrip_zero_compiles(tmp_path):
+    """Satellite: export -> new-process-style reload -> serve.  The
+    reloaded library must route the whole circuit with ZERO dispatch
+    compiles and results bit-identical to the plain jit path."""
+    from parallel_eda_tpu.flow import synth_flow
+
+    f = synth_flow(num_luts=15, seed=1)
+    base = dict(batch_size=32, sink_group=0)
+    ref = Router(f.rr, RouterOpts(**base)).route(f.term)
+    assert ref.success
+
+    lib_dir = str(tmp_path / "lib")
+    warm = Router(f.rr, RouterOpts(**base,
+                                   program_library_dir=lib_dir))
+    res_w = warm.route(f.term)
+    assert res_w.success and res_w.wirelength == ref.wirelength
+    assert warm.export_program_library() > 0
+
+    # "fresh process": forget every seen variant and all counters; the
+    # only warm state left is the library directory on disk
+    saved = set(router_mod._DISPATCH_VARIANTS)
+    router_mod._DISPATCH_VARIANTS.clear()
+    set_metrics(MetricsRegistry())
+    try:
+        serve = Router(f.rr, RouterOpts(**base,
+                                        program_library_dir=lib_dir))
+        assert serve._library.stale_reason is None
+        assert len(serve._library.keys()) > 0
+        res = serve.route(f.term)
+        v = get_metrics().values()
+        # zero compiles means the counter was never even created
+        assert v.get("route.dispatch.compiles", 0) == 0
+        assert v["route.dispatch.cache_hits"] > 0
+        assert v["route.serve.aot_hits"] > 0
+        assert "route.serve.jit_fallbacks" not in v
+        assert "route.serve.aot_errors" not in v
+    finally:
+        router_mod._DISPATCH_VARIANTS |= saved
+    # bit-identical to the jit path
+    assert res.success
+    assert res.wirelength == ref.wirelength
+    assert res.iterations == ref.iterations
+    assert np.array_equal(res.paths, ref.paths)
+    assert np.array_equal(res.occ, ref.occ)
+    check_route(f.rr, f.term, res.paths, occ=res.occ)
+
+
+# ---- service + satellite-1 multi-route safety ----------------------
+
+def test_service_two_tenants_preemption_parity(tmp_path):
+    """Two tenants' jobs through the queue with preemption slices:
+    each job's QoR is identical to routing it alone, results are
+    legal, and the corpus rows carry the tenant."""
+    import parallel_eda_tpu.obs.runstore as rs
+    from parallel_eda_tpu.flow import synth_flow
+    from parallel_eda_tpu.serve.service import RouteService, ServeJobSpec
+
+    flows = [synth_flow(num_luts=15, seed=s) for s in (1, 2)]
+    base = dict(batch_size=32, sink_group=0)
+    solo = {}
+    for fl in flows:
+        r = Router(fl.rr, RouterOpts(**base)).route(fl.term)
+        assert r.success
+        solo[id(fl)] = r
+
+    runs = str(tmp_path / "runs")
+    svc = RouteService(flows[0].rr, RouterOpts(**base), slice_iters=2,
+                       runs_dir=runs, scenario="serve_test",
+                       cfg={"luts": 15})
+    for i, fl in enumerate(flows):
+        svc.admit(ServeJobSpec(term=fl.term, name=f"s{i + 1}"),
+                  tenant=f"t{i}")
+    jobs = svc.run()
+    assert [j.state for j in jobs] == [JobState.DONE] * 2
+    assert all(j.preemptions > 0 for j in jobs)
+    for job, fl in zip(jobs, flows):
+        assert job.result["wirelength"] == solo[id(fl)].wirelength
+        res = job.result["result"]
+        check_route(fl.rr, fl.term, res.paths, occ=res.occ)
+    v = get_metrics().values("route.serve.")
+    assert v["route.serve.jobs_done"] == 2
+    assert v["route.serve.tenant.t0.jobs_done"] == 1
+    assert v["route.serve.tenant.t1.wirelength"] == \
+        solo[id(flows[1])].wirelength
+    assert v["route.serve.pack.jobs"] == 2
+    recs = rs.read_runs(runs, "serve_test")
+    assert sorted(r["tenant"] for r in recs) == ["t0", "t1"]
+    assert all(r["job_id"] for r in recs)
+
+
+def test_router_reuse_reasserts_compile_cache(tmp_path):
+    """Satellite: two Routers with different compile_cache_dirs in one
+    process — route() must re-assert ITS dir (the process global moved
+    when the second Router initialized)."""
+    from parallel_eda_tpu.flow import synth_flow
+
+    dir_a = str(tmp_path / "cc_a")
+    dir_b = str(tmp_path / "cc_b")
+    f = synth_flow(num_luts=10, seed=1)
+    ra = Router(f.rr, RouterOpts(batch_size=16, sink_group=0,
+                                 compile_cache_dir=dir_a))
+    assert router_mod._COMPILE_CACHE_DIR == dir_a
+    Router(f.rr, RouterOpts(batch_size=16, sink_group=0,
+                            compile_cache_dir=dir_b))
+    assert router_mod._COMPILE_CACHE_DIR == dir_b
+    # leak a previous job's pipeline gauge; route() zeroes it at entry
+    get_metrics().gauge("route.pipeline.stall_ms_total").set(1e9)
+    res = ra.route(f.term)
+    assert res.success
+    assert router_mod._COMPILE_CACHE_DIR == dir_a
+    v = get_metrics().values("route.pipeline.")
+    assert v["route.pipeline.stall_ms_total"] < 1e9

@@ -314,6 +314,22 @@ def check_corpus(runs_dir: str, scenario, nets_tol: float,
                         f"(missing or all-invalid "
                         f"{rs.run_path(runs_dir, name)})")
             continue
+        # multi-tenant scenarios (serve rows, runstore schema v2) carry
+        # one row PER JOB: gate each (tenant, job_id) sub-trajectory on
+        # its own history — jobs route different circuits, so comparing
+        # one job's wirelength against another's median is noise
+        if any(r.get("tenant") or r.get("job_id") for r in records):
+            groups = {}
+            for r in records:
+                groups.setdefault(
+                    (r.get("tenant"), r.get("job_id")), []).append(r)
+            for (ten, jid), recs in sorted(
+                    groups.items(), key=lambda kv: str(kv[0])):
+                tag = f"{name}:{ten or '-'}/{jid or '-'}"
+                se, sn = check_corpus_scenario(rs, recs, nets_tol, k)
+                errs += [f"corpus[{tag}]: {e}" for e in se]
+                notes += [f"corpus[{tag}]: {n}" for n in sn]
+            continue
         se, sn = check_corpus_scenario(rs, records, nets_tol, k)
         errs += [f"corpus[{name}]: {e}" for e in se]
         notes += [f"corpus[{name}]: {n}" for n in sn]

@@ -201,20 +201,41 @@ def print_report(rs, runs_dir: str, scenario=None, last: int = 10,
             continue
         shown += 1
         print(f"\n## {name}  ({len(recs)} run(s))", file=out)
-        print("| ts | git | backend | device | metric | value | "
-              "wirelength | iters | era |", file=out)
-        print("|---|---|---|---|---|---|---|---|---|", file=out)
-        for r in recs[-last:]:
-            qor = r.get("qor") or {}
-            era = "pre_pr2" if (r.get("tags") or {}).get("pre_pr2") \
-                else ("replay" if (r.get("tags") or {}).get("replay")
-                      else "")
-            print(f"| {r.get('ts')} | {r.get('git_rev')} "
-                  f"| {r.get('backend')} | {r.get('device_kind')} "
-                  f"| {r.get('metric')} | {_fmt(r.get('value'))} "
-                  f"| {_fmt(qor.get('wirelength'))} "
-                  f"| {_fmt(qor.get('iterations'))} | {era} |",
-                  file=out)
+        # multi-tenant scenarios (schema v2 route-service rows) trend
+        # per tenant — one table per tenant so a noisy neighbour's rows
+        # don't interleave into another tenant's trajectory; scenarios
+        # with no tenant field keep the flat single table
+        if any(r.get("tenant") for r in recs):
+            by_tenant = {}
+            for r in recs:
+                by_tenant.setdefault(r.get("tenant") or "-",
+                                     []).append(r)
+            groups = sorted(by_tenant.items())
+        else:
+            groups = [(None, recs)]
+        for tenant, grecs in groups:
+            if tenant is not None:
+                print(f"\n### tenant {tenant}  ({len(grecs)} run(s))",
+                      file=out)
+            jobs = tenant is not None
+            print("| ts | git | backend | device | metric | value | "
+                  "wirelength | iters | era |"
+                  + (" job |" if jobs else ""), file=out)
+            print("|---|---|---|---|---|---|---|---|---|"
+                  + ("---|" if jobs else ""), file=out)
+            for r in grecs[-last:]:
+                qor = r.get("qor") or {}
+                era = "pre_pr2" if (r.get("tags") or {}).get("pre_pr2") \
+                    else ("replay" if (r.get("tags") or {}).get("replay")
+                          else "")
+                line = (f"| {r.get('ts')} | {r.get('git_rev')} "
+                        f"| {r.get('backend')} | {r.get('device_kind')} "
+                        f"| {r.get('metric')} | {_fmt(r.get('value'))} "
+                        f"| {_fmt(qor.get('wirelength'))} "
+                        f"| {_fmt(qor.get('iterations'))} | {era} |")
+                if jobs:
+                    line += f" {r.get('job_id') or '-'} |"
+                print(line, file=out)
         pair = pick_attribution_pair(recs)
         if pair is None:
             print("\n(attribution: no same-backend pair yet)", file=out)

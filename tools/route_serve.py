@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Multi-tenant route service front end (thin wrapper).
+
+Same CLI as `python -m parallel_eda_tpu serve` — the implementation
+lives in parallel_eda_tpu/serve/cli.py; this script only makes it
+runnable from a checkout without installing the package:
+
+    python tools/route_serve.py --jobs 4 --tenants 2 --luts 15 \
+        --library progs/ --compile_cache_dir cc/
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from parallel_eda_tpu.serve.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
