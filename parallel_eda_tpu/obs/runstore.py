@@ -174,43 +174,70 @@ def run_path(runs_dir: str, scenario: str) -> str:
 
 def append_run(runs_dir: str, rec: dict) -> str:
     """Validate + append one record to runs/<scenario>.jsonl (one JSON
-    object per line, append-only).  Returns the file path."""
+    object per line, append-only).  Returns the file path.
+
+    The append is ONE O_APPEND os.write of the fully-encoded line:
+    POSIX appends of a single write are atomic with respect to other
+    appenders, and a crash can only ever leave a torn *trailing* line
+    — which read_runs_ex skips with a counted warning — never
+    interleave two writers' bytes into one poisoned line."""
     errs = validate_record(rec)
     if errs:
         raise ValueError(f"invalid corpus record: {errs}")
     path = run_path(runs_dir, rec["scenario"])
     os.makedirs(runs_dir, exist_ok=True)
-    with open(path, "a") as f:
-        f.write(json.dumps(rec, sort_keys=True) + "\n")
+    data = (json.dumps(rec, sort_keys=True) + "\n").encode("utf-8")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o666)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
     return path
+
+
+def read_runs_ex(runs_dir: str, scenario: str,
+                 strict: bool = False) -> tuple:
+    """(records, skipped) of one scenario, oldest first.  Corrupt,
+    torn-trailing, or schema-invalid lines are counted and skipped
+    with a warning (the corpus outlives crashes and schema mistakes)
+    unless strict, which raises on the first one.  The file is read
+    as bytes: a torn multi-byte UTF-8 sequence must count as one more
+    skipped line, not crash the reader."""
+    import warnings
+
+    path = run_path(runs_dir, scenario)
+    if not os.path.exists(path):
+        return [], 0
+    with open(path, "rb") as f:
+        data = f.read()
+    out, skipped = [], 0
+    for i, raw in enumerate(data.split(b"\n"), 1):
+        if not raw.strip():
+            continue
+        try:
+            rec = json.loads(raw.decode("utf-8"))
+            errs = validate_record(rec)
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            rec, errs = None, [f"unparseable line: {e}"]
+        if errs:
+            if strict:
+                raise ValueError(
+                    f"{path}:{i}: invalid record: {errs}")
+            skipped += 1
+            continue
+        out.append(rec)
+    if skipped:
+        warnings.warn(
+            f"{path}: skipped {skipped} corrupted/torn JSONL "
+            f"line(s)", RuntimeWarning, stacklevel=2)
+    return out, skipped
 
 
 def read_runs(runs_dir: str, scenario: str,
               strict: bool = False) -> list:
-    """Records of one scenario, oldest first.  Invalid lines are
-    skipped (the corpus outlives schema mistakes) unless strict, which
-    raises on the first one."""
-    path = run_path(runs_dir, scenario)
-    if not os.path.exists(path):
-        return []
-    out = []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-                errs = validate_record(rec)
-            except json.JSONDecodeError as e:
-                rec, errs = None, [f"unparseable JSON: {e}"]
-            if errs:
-                if strict:
-                    raise ValueError(
-                        f"{path}:{i}: invalid record: {errs}")
-                continue
-            out.append(rec)
-    return out
+    """Records of one scenario, oldest first; see read_runs_ex for
+    the skip/warn contract on corrupt lines."""
+    return read_runs_ex(runs_dir, scenario, strict=strict)[0]
 
 
 def scenarios(runs_dir: str) -> list:

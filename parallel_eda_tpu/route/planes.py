@@ -1050,7 +1050,8 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                sel, valid, force, full_bb,
                nsweeps: int, max_len: int, num_waves: int, group: int,
                doubling: bool, mesh, use_pallas: bool = False,
-               crop_tile=None, bb0_all=None, widen_ok=None):
+               crop_tile=None, bb0_all=None, widen_ok=None,
+               pallas_g1: bool = False):
     """One fused batch step (traceable body shared by the standalone
     per-batch wrapper and the window program): rip up the selected nets,
     re-route each against the occupancy view of everyone-but-itself with
@@ -1208,11 +1209,13 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                 from .planes_pallas import planes_relax_cropped_pallas
                 dist, pred, wenter, rst = planes_relax_cropped_pallas(
                     pg, d0, cc_flat, crit_c, wenter0, nsweeps,
-                    crop_ox, crop_oy, cnx_t, cny_t)
+                    crop_ox, crop_oy, cnx_t, cny_t,
+                    block_nets=1 if pallas_g1 else None)
             else:
                 from .planes_pallas import planes_relax_pallas
                 dist, pred, wenter, rst = planes_relax_pallas(
-                    pg, d0, cc_flat, crit_c, wenter0, nsweeps)
+                    pg, d0, cc_flat, crit_c, wenter0, nsweeps,
+                    block_nets=1 if pallas_g1 else None)
         elif crop_tile is not None:
             dist, pred, wenter, rst = planes_relax_cropped(
                 pg, d0, cc_flat, crit_c, wenter0, nsweeps,
@@ -1522,7 +1525,8 @@ def _mis_colors(dev: DeviceRRGraph, occ, paths, all_reached,
 WINDOW_STATIC_ARGNAMES = ("K_iters", "nsweeps", "max_len", "num_waves",
                           "group", "doubling", "topk", "n_colors",
                           "mesh", "sta_depth", "crit_exp", "max_crit",
-                          "use_sdc", "use_pallas", "crop_tile")
+                          "use_sdc", "use_pallas", "crop_tile",
+                          "pallas_g1")
 
 
 @functools.partial(
@@ -1545,7 +1549,8 @@ def route_window_planes(
         tdev=None, req_seed=None, sta_depth: int = 0,
         crit_exp: float = 1.0, max_crit: float = 0.99,
         use_sdc: bool = False, use_pallas: bool = False,
-        crop_tile=None, bb0_all=None, widen_ok=None):
+        crop_tile=None, bb0_all=None, widen_ok=None,
+        pallas_g1: bool = False):
     """A WINDOW of K_iters complete PathFinder iterations as ONE device
     program: per iteration, every batch group in sel_plan [G, B] runs the
     fused rip-up/route/commit step (clean nets no-op via the device-side
@@ -1602,7 +1607,7 @@ def route_window_planes(
                     direct_oidx_all, direct_ipin_all, direct_delay_all,
                     sel_plan[g], valid_plan[g], force, full_bb,
                     nsweeps, max_len, num_waves, group, doubling, mesh,
-                    use_pallas, crop_tile, bb0_all, widen_ok)
+                    use_pallas, crop_tile, bb0_all, widen_ok, pallas_g1)
                 return (occ2, paths2, sink_delay2, all_reached2, bb2,
                         nr + n_act, ng + 1, se + st_exec, su + st_useful)
 

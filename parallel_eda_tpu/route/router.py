@@ -196,6 +196,13 @@ class RouterOpts:
     # Router.export_program_library().  None = off.  Single-device
     # planes programs only (exported modules bake one partitioning)
     program_library_dir: Optional[str] = None
+    # Resilience runtime (resil.Resilience, duck-typed: .plan/.guard/
+    # .ladder).  When set, every window dispatch runs under the
+    # watchdog guard through a chain of bit-identical rungs (AOT ->
+    # jit -> Pallas G=1 -> XLA) with retry/backoff/quarantine, and
+    # fault-injection sites are armed.  None = off (the default path
+    # is byte-for-byte the non-resil dispatch)
+    resil: Optional[object] = None
 
 
 @dataclass
@@ -671,6 +678,57 @@ class Router:
         get_metrics().gauge("route.serve.library_variants").set(
             len(self._library.keys()))
         return n
+
+    def _guarded_dispatch(self, resil_rt, vkey, wp_args, wp_kwargs):
+        """Window dispatch under the resilience guard: an ordered
+        chain of BIT-IDENTICAL execution rungs, fastest first, handed
+        to DispatchGuard.run (retry with capped backoff, per-variant
+        quarantine, descent).  Rung set per the degradation ladder:
+        AOT library -> live jit -> Pallas G=1 -> XLA.  Each rung notes
+        its own variant key so route.dispatch.{compiles,cache_hits}
+        stays honest about which program actually ran."""
+        from ..resil.watchdog import Rung
+        from .planes import route_window_planes
+        ladder = resil_rt.ladder
+        rungs = []
+        if (self._library is not None
+                and ladder.level("program") == 0):
+            def run_aot():
+                _note_dispatch_variant(vkey)
+                return self._library.dispatch(
+                    vkey, route_window_planes, wp_args, wp_kwargs)
+
+            def evict_aot(reason):
+                # blacklist the variant from the AOT cache so a later
+                # library process never serves the quarantined entry
+                self._library.evict(vkey, reason)
+
+            rungs.append(Rung("aot", run_aot, evict_aot))
+
+        def run_jit():
+            _note_dispatch_variant(vkey)
+            return route_window_planes(*wp_args, **wp_kwargs)
+
+        rungs.append(Rung("jit", run_jit))
+        if self.use_pallas and ladder.level("kernel") <= 1:
+            key_g1 = vkey + ("pallas_g1",)
+
+            def run_g1():
+                _note_dispatch_variant(key_g1)
+                return route_window_planes(
+                    *wp_args, **{**wp_kwargs, "pallas_g1": True})
+
+            rungs.append(Rung("pallas_g1", run_g1))
+        if self.use_pallas:
+            key_xla = vkey + ("xla",)
+
+            def run_xla():
+                _note_dispatch_variant(key_xla)
+                return route_window_planes(
+                    *wp_args, **{**wp_kwargs, "use_pallas": False})
+
+            rungs.append(Rung("xla", run_xla))
+        return resil_rt.guard.run(vkey, rungs)
 
     @staticmethod
     def _dump_routes(stats_dir: str, it: int, paths: np.ndarray,
@@ -1331,7 +1389,11 @@ class Router:
                         sel_p.shape[0], sel_p.shape[1], wok is None,
                         self.use_pallas, self.mesh is not None,
                         bool(sta_kw), R, Smax, N)
-                _note_dispatch_variant(vkey)
+                resil_rt = getattr(opts, "resil", None)
+                if resil_rt is None or resil_rt.guard is None:
+                    # resil dispatch notes per executed rung instead
+                    # (a degraded rung compiles a different program)
+                    _note_dispatch_variant(vkey)
                 wp_args = (
                     self.pg, dev, occ, acc, paths, sink_delay,
                     all_reached, bb, source_d, sinks_d, crit_d,
@@ -1355,7 +1417,14 @@ class Router:
                 get_devprof().note_variant(
                     (tile, K, nsw, L, waves, grp_w), kplan,
                     route_window_planes, wp_args, wp_kwargs)
-                if self._library is not None:
+                if resil_rt is not None and resil_rt.guard is not None:
+                    # guarded dispatch: watchdog + retry/backoff over
+                    # a chain of bit-identical rungs (AOT -> jit ->
+                    # Pallas G=1 -> XLA); injected faults fire before
+                    # the call so donated buffers survive retries
+                    out = self._guarded_dispatch(
+                        resil_rt, vkey, wp_args, wp_kwargs)
+                elif self._library is not None:
                     # AOT library serve: known variants run from the
                     # deserialized exported executable (no trace/
                     # lower); misses note their avatarized args for

@@ -66,6 +66,23 @@ def build_parser() -> argparse.ArgumentParser:
                    "job config)")
     p.add_argument("--sync", action="store_true",
                    help="disable the host-device pipeline")
+    p.add_argument("--checkpoint_dir", default="",
+                   help="durable crash-safe job checkpoints (resil/"
+                   "checkpoint.py): preempted slices flush here and a "
+                   "fresh process resumes bit-identically")
+    p.add_argument("--diag_dir", default="",
+                   help="diagnostic bundles for poisoned jobs "
+                   "(default: --checkpoint_dir)")
+    p.add_argument("--chaos", default="",
+                   help="seeded fault schedule, e.g. "
+                   "'dispatch.hang:2:4,backend.loss:1:3' "
+                   "(site:count[:horizon], resil/faults.py)")
+    p.add_argument("--chaos_seed", type=int, default=7,
+                   help="seed the --chaos schedule replays from")
+    p.add_argument("--watchdog_s", type=float, default=120.0,
+                   help="per-dispatch watchdog budget (resil)")
+    p.add_argument("--dispatch_attempts", type=int, default=2,
+                   help="attempts per dispatch rung before quarantine")
     return p
 
 
@@ -101,12 +118,23 @@ def main(argv=None) -> int:
         sink_group=0, pipeline=not args.sync,
         compile_cache_dir=args.compile_cache_dir or None,
         program_library_dir=args.library or None)
+    resil = None
+    if args.chaos or args.checkpoint_dir or args.diag_dir:
+        from ..resil import FaultPlan, ResilOpts
+        resil = ResilOpts(
+            fault_plan=(FaultPlan.parse(args.chaos_seed, args.chaos)
+                        if args.chaos else None),
+            checkpoint_dir=args.checkpoint_dir or None,
+            diag_dir=args.diag_dir or None,
+            watchdog_s=args.watchdog_s,
+            dispatch_attempts=args.dispatch_attempts)
     svc = RouteService(
         rr, opts, slice_iters=args.slice_iters,
         runs_dir=args.runs_dir or None, scenario=scenario,
         cfg=dict(luts=args.luts, chan_width=args.chan_width,
                  jobs=args.jobs, batch=args.batch_size,
-                 slice=args.slice_iters))
+                 slice=args.slice_iters),
+        resil=resil)
     for j, f in enumerate(flows):
         svc.admit(
             ServeJobSpec(term=f.term, name=f"l{args.luts}_s{args.seed0 + j}",
@@ -129,6 +157,7 @@ def main(argv=None) -> int:
              "state": j.state.value,
              "preemptions": j.preemptions, "slices": j.slices,
              "error": j.error,
+             "failure_reason": j.failure_reason,
              **({k: v for k, v in j.result.items()
                  if k != "result"} if isinstance(j.result, dict)
                 else {})}
@@ -141,6 +170,13 @@ def main(argv=None) -> int:
         "library_exported": exported,
         "wall_s": round(time.perf_counter() - t_start, 3),
     }
+    if svc.resil is not None:
+        summary["resil"] = {
+            "metrics": m.values("route.resil."),
+            "ladder": svc.resil.ladder.snapshot(),
+            "faults": (svc.resil.plan.summary()
+                       if svc.resil.plan is not None else None),
+        }
     print(json.dumps(summary, default=str))
     return 0 if all(j.state.value == "done" for j in jobs) else 1
 

@@ -211,6 +211,8 @@ class ProgramLibrary:
         self._loaded: Dict[str, Any] = {}             # kid -> Exported
         self._pending: Dict[str, Dict[str, Any]] = {} # kid -> capture
         self._dead: set = set()                       # kid evicted
+        self.dropped: List[Tuple[str, str]] = []      # (kid, reason)
+        self.fault_plan = None  # optional resil FaultPlan (set by serve)
 
     # ---------------------------------------------------------- load
 
@@ -243,6 +245,21 @@ class ProgramLibrary:
             blob = os.path.join(self.dir, meta.get("file", ""))
             if not os.path.exists(blob):
                 continue
+            # Content checksum: a truncated/torn blob (crash mid-write
+            # on a pre-atomic writer, disk corruption) must degrade to
+            # the jit path here, not raise at first dispatch — and
+            # must not pre-register its key as a warm variant.
+            want_sha = meta.get("sha256")
+            if want_sha is not None:
+                with open(blob, "rb") as f:
+                    got = hashlib.sha256(f.read()).hexdigest()
+                if got != want_sha:
+                    get_metrics().counter("route.serve.aot_errors").inc()
+                    self.dropped.append(
+                        (kid, f"checksum mismatch (torn file?): "
+                              f"{got[:12]} != {want_sha[:12]}"))
+                    self._dead.add(kid)
+                    continue
             self._index[kid] = meta
             self._keys[kid] = _tupled(meta["key"])
         return len(self._index)
@@ -259,6 +276,16 @@ class ProgramLibrary:
         meta = self._index[kid]
         with open(os.path.join(self.dir, meta["file"]), "rb") as f:
             blob = f.read()
+        # re-verify at read time (load() may be long past): any
+        # corruption raises here and dispatch()'s except degrades to
+        # the jit path with an aot_errors count
+        want_sha = meta.get("sha256")
+        if want_sha is not None:
+            got = hashlib.sha256(blob).hexdigest()
+            if got != want_sha:
+                raise ValueError(
+                    f"library blob {meta['file']} checksum mismatch "
+                    f"(torn file?)")
         exp = jexport.deserialize(bytearray(blob))
         self._loaded[kid] = exp
         return exp
@@ -304,13 +331,20 @@ class ProgramLibrary:
                 self.stale_reason = f"export_failed: {e}"
                 continue
             fname = f"{kid}.jexp"
-            with open(os.path.join(self.dir, fname), "wb") as f:
+            # atomic blob install (tmp + rename) so a crash mid-export
+            # can never leave a torn .jexp behind a valid index entry
+            fpath = os.path.join(self.dir, fname)
+            with open(fpath + ".tmp", "wb") as f:
                 f.write(bytes(blob))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(fpath + ".tmp", fpath)
             self._index[kid] = {
                 "key": list(cap["key"]),
                 "file": fname,
                 "sig": cap["sig"],
                 "bytes": len(blob),
+                "sha256": hashlib.sha256(bytes(blob)).hexdigest(),
             }
             self._keys[kid] = cap["key"]
             del self._pending[kid]
@@ -328,6 +362,35 @@ class ProgramLibrary:
         os.replace(tmp, os.path.join(self.dir, INDEX_NAME))
         return written
 
+    # ------------------------------------------------------ eviction
+
+    def evict(self, key: Tuple, reason: str = "") -> None:
+        """Blacklist a variant from the AOT cache (resil quarantine):
+        dead for this process AND removed from the on-disk index so a
+        later process never serves the entry either."""
+        kid = key_id(key)
+        self._dead.add(kid)
+        self._loaded.pop(kid, None)
+        self.dropped.append((kid, reason or "evicted"))
+        if self._index.pop(kid, None) is None:
+            return
+        self._keys.pop(kid, None)
+        get_metrics().counter("route.serve.library_evictions").inc()
+        path = os.path.join(self.dir, INDEX_NAME)
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                idx = json.load(f)
+            if kid in idx.get("entries", {}):
+                del idx["entries"][kid]
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(idx, f, indent=1, default=str)
+                os.replace(tmp, path)
+        except (OSError, ValueError):
+            pass  # in-process blacklist still holds
+
     # ------------------------------------------------------ dispatch
 
     def dispatch(self, key: Tuple, fn: Callable,
@@ -337,6 +400,12 @@ class ProgramLibrary:
         kid = key_id(key)
         if kid in self._index and kid not in self._dead:
             try:
+                if self.fault_plan is not None:
+                    # injected stale/truncated-entry fault: exercises
+                    # the same evict-and-degrade path a real torn blob
+                    # takes
+                    self.fault_plan.raise_if("library.corrupt",
+                                             detail=kid)
                 meta = self._index[kid]
                 sig = _sig_digest(fn, args, kwargs)
                 if meta.get("sig") not in (None, sig):

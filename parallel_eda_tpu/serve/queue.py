@@ -51,6 +51,7 @@ class RouteJob:
     max_retries: int = 0
     backoff_s: float = 0.05
     backoff_mult: float = 2.0
+    backoff_max_s: float = 2.0         # exponential backoff ceiling
     state: JobState = JobState.QUEUED
     attempts: int = 0
     preemptions: int = 0
@@ -66,6 +67,15 @@ class RouteJob:
         return (self.deadline_s is not None
                 and now - self.admitted_t > self.deadline_s)
 
+    @property
+    def failure_reason(self) -> Optional[str]:
+        """Terminal failure reason for the job summary JSON; None for
+        non-terminal or successful states."""
+        if self.state in (JobState.FAILED, JobState.TIMEOUT):
+            return (f"{self.state.value}: {self.error} "
+                    f"(attempts={self.attempts})")
+        return None
+
 
 Outcome = Tuple[str, Any]
 Runner = Callable[[RouteJob], Outcome]
@@ -74,10 +84,12 @@ Runner = Callable[[RouteJob], Outcome]
 class JobQueue:
     """Priority heap + cooperative run loop."""
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
         self._heap: List[Tuple[int, int, RouteJob]] = []
         self._seq = 0
         self._clock = clock
+        self._sleep = sleep
         self.jobs: List[RouteJob] = []
 
     # ------------------------------------------------------ admit
@@ -130,7 +142,7 @@ class JobQueue:
                 self._push(job)
                 if all(self._clock() < j.not_before
                        for _, _, j in self._heap):
-                    time.sleep(max(0.0, job.not_before - self._clock()))
+                    self._sleep(max(0.0, job.not_before - self._clock()))
                 continue
             job.state = JobState.RUNNING
             job.slices += 1
@@ -155,13 +167,27 @@ class JobQueue:
                     job.state = JobState.FAILED
                     m.counter("route.serve.jobs_failed").inc()
                 else:
-                    back = job.backoff_s * (
-                        job.backoff_mult ** (job.attempts - 1))
-                    job.not_before = self._clock() + back
-                    job.checkpoint = None  # retry restarts clean
-                    job.state = JobState.QUEUED
-                    m.counter("route.serve.jobs_retried").inc()
-                    self._push(job)
+                    back = min(job.backoff_max_s,
+                               job.backoff_s * (
+                                   job.backoff_mult
+                                   ** (job.attempts - 1)))
+                    nb = self._clock() + back
+                    if (job.deadline_s is not None
+                            and nb - job.admitted_t > job.deadline_s):
+                        # the retry could only start past the deadline:
+                        # fail fast instead of sleeping into a TIMEOUT
+                        job.state = JobState.TIMEOUT
+                        job.error = (
+                            f"retry backoff {back:.3f}s lands past "
+                            f"deadline {job.deadline_s}s "
+                            f"(after: {value})")
+                        m.counter("route.serve.jobs_timeout").inc()
+                    else:
+                        job.not_before = nb
+                        job.checkpoint = None  # retry restarts clean
+                        job.state = JobState.QUEUED
+                        m.counter("route.serve.jobs_retried").inc()
+                        self._push(job)
             else:
                 raise ValueError(f"runner returned {verdict!r}")
             self._depth_gauge()

@@ -16,6 +16,8 @@ shareable; admit() enforces it.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional
@@ -23,6 +25,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..obs.metrics import get_metrics
+from ..resil import Resilience, ResilOpts
+from ..resil.watchdog import DispatchPoisonedError
 from ..route.router import Router, RouterOpts
 from .batcher import pack_jobs
 from .queue import JobQueue, JobState, RouteJob
@@ -44,13 +48,25 @@ class RouteService:
                  slice_iters: int = 0, verify: bool = True,
                  runs_dir: Optional[str] = None,
                  scenario: str = "serve_smoke",
-                 cfg: Optional[dict] = None):
+                 cfg: Optional[dict] = None,
+                 resil: Optional[ResilOpts] = None):
         """``slice_iters`` > 0 preempts each job after that many router
         iterations (checkpointed, requeued) — the fairness knob; 0
-        runs each job to completion in one slice."""
+        runs each job to completion in one slice.  ``resil`` arms the
+        resilience layer: guarded dispatches, durable checkpoints
+        (when a checkpoint_dir is set), fault-injection sites, and
+        diagnostic bundles for poisoned jobs."""
         self.rr = rr
-        self.base_opts = opts or RouterOpts()
+        self.resil = Resilience(resil) if resil is not None else None
+        base = opts or RouterOpts()
+        if self.resil is not None:
+            base = replace(base, resil=self.resil)
+        self.base_opts = base
         self.router = Router(rr, self.base_opts)
+        if (self.resil is not None and self.resil.plan is not None
+                and self.router._library is not None):
+            # arm the library.corrupt injection site
+            self.router._library.fault_plan = self.resil.plan
         self.slice_iters = int(slice_iters)
         self.verify = verify
         self.runs_dir = runs_dir
@@ -102,18 +118,43 @@ class RouteService:
     def _runner(self, job: RouteJob):
         spec = job.payload
         total = spec.max_iterations or self.base_opts.max_router_iterations
+        rt = self.resil
+        if rt is not None and rt.plan is not None:
+            # simulated backend loss fires BEFORE any routing work:
+            # the attempt dies clean, the queue retries with backoff,
+            # and the retry resumes from the durable checkpoint
+            rt.plan.raise_if("backend.loss", detail=job.job_id)
         ck = job.checkpoint
+        if ck is None and rt is not None and rt.store is not None:
+            # fresh process (or a queue retry, which clears the
+            # in-memory checkpoint): resume from the newest verifiable
+            # durable snapshot — bit-identical, the resume path just
+            # replays the remaining deterministic iterations
+            ck = rt.store.load(job.job_id)
         # slice via RouterOpts.slice_iterations (cooperative yield at a
         # window boundary), NOT by shrinking max_router_iterations —
         # the iteration budget feeds the router's per-window K clamp,
         # so capping it would change the window partition and with it
         # the QoR.  The yield path leaves window planning untouched:
         # sliced-and-resumed == unsliced, bit for bit.
-        self.router.opts = replace(
-            self.base_opts, max_router_iterations=total,
-            slice_iterations=max(0, self.slice_iters))
+        kw = dict(max_router_iterations=total,
+                  slice_iterations=max(0, self.slice_iters))
+        if (rt is not None and self.base_opts.pipeline
+                and rt.ladder.level("pipeline") > 0):
+            kw["pipeline"] = False   # degraded: the --sync escape hatch
+        self.router.opts = replace(self.base_opts, **kw)
         t0 = time.perf_counter()
-        res = self.router.route(spec.term, crit=spec.crit, resume=ck)
+        try:
+            res = self.router.route(spec.term, crit=spec.crit,
+                                    resume=ck)
+        except DispatchPoisonedError as e:
+            # every rung of some dispatch chain is exhausted: step the
+            # global ladder so the retry runs one level down, then let
+            # the queue count the failed attempt (and bury the job
+            # into FAILED + diagnostic bundle once retries run out)
+            if rt is not None:
+                rt.ladder.step("pipeline", reason=str(e))
+            raise
         dt = time.perf_counter() - t0
         if self._first_slice_s is None:
             self._first_slice_s = time.perf_counter() - self._t_init
@@ -121,12 +162,18 @@ class RouteService:
                 round(self._first_slice_s, 3))
         job.scratch["route_s"] = job.scratch.get("route_s", 0.0) + dt
         if res.success:
+            if rt is not None and rt.store is not None:
+                rt.store.drop(job.job_id)
             return "done", self._finish(job, res)
         ck2 = res.checkpoint
         prev_it = ck.it_done if ck is not None else 0
         if (ck2 is not None and ck2.it_done < total
                 and ck2.it_done > prev_it):
-            # made progress and the budget isn't exhausted: requeue
+            # made progress and the budget isn't exhausted: requeue.
+            # The durable flush rides the same window-boundary
+            # snapshot: a crash between slices resumes from here
+            if rt is not None and rt.store is not None:
+                rt.store.save(job.job_id, ck2)
             return "preempted", ck2
         return "failed", f"unroutable within {total} iterations"
 
@@ -161,9 +208,25 @@ class RouteService:
     def _corpus_row(self, job: RouteJob, res, nets_per_s: float):
         import jax
 
-        from ..obs.runstore import append_run, make_record
+        from ..obs.runstore import append_run, make_record, run_path
         spec = job.payload
         dev = jax.devices()[0]
+        rt = self.resil
+        if rt is not None and rt.plan is not None:
+            f = rt.plan.fire("corpus.torn", detail=job.job_id)
+            if f is not None:
+                # inject a corrupt line (invalid UTF-8, invalid JSON)
+                # ahead of the real append: the tolerant reader must
+                # skip it with a counted warning, and flow_doctor
+                # --corpus must stay green
+                path = run_path(self.runs_dir, self.scenario)
+                os.makedirs(self.runs_dir, exist_ok=True)
+                fd = os.open(path,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+                try:
+                    os.write(fd, b'\x80\xfe{"torn": tr\n')
+                finally:
+                    os.close(fd)
         rec = make_record(
             scenario=self.scenario,
             cfg={**self.cfg, "job": spec.name, "tenant": job.tenant},
@@ -173,7 +236,8 @@ class RouteService:
             qor=dict(wirelength=int(res.wirelength),
                      iterations=int(res.iterations),
                      success=bool(res.success)),
-            gauges=get_metrics().values("route.serve."),
+            gauges={**get_metrics().values("route.serve."),
+                    **get_metrics().values("route.resil.")},
             detail=dict(preemptions=job.preemptions,
                         slices=job.slices, **spec.detail),
             tenant=job.tenant, job_id=job.job_id)
@@ -190,4 +254,46 @@ class RouteService:
         nets = sum(len(j.payload.term.source) for j in done)
         get_metrics().gauge("route.serve.aggregate_nets_per_s").set(
             round(nets / max(wall, 1e-9), 3))
+        if self.resil is not None:
+            for j in jobs:
+                if j.state in (JobState.FAILED, JobState.TIMEOUT):
+                    self._diag_bundle(j)
         return jobs
+
+    def _diag_bundle(self, job: RouteJob) -> Optional[str]:
+        """Export a diagnostic bundle for a terminally-failed job: the
+        failure reason, attempt/quarantine/ladder state, fault log and
+        checkpoint provenance, as one JSON file — the poison job's
+        post-mortem, instead of a wedged queue and a stack trace."""
+        rt = self.resil
+        diag_dir = rt.opts.diag_dir or rt.opts.checkpoint_dir
+        if diag_dir is None:
+            return None
+        os.makedirs(diag_dir, exist_ok=True)
+        ck_meta = None
+        if rt.store is not None:
+            p = rt.store._path(job.job_id)
+            if os.path.exists(p):
+                ck_meta = {"file": p, "bytes": os.path.getsize(p)}
+        bundle = {
+            "job_id": job.job_id,
+            "tenant": job.tenant,
+            "state": job.state.value,
+            "failure_reason": job.failure_reason,
+            "attempts": job.attempts,
+            "preemptions": job.preemptions,
+            "slices": job.slices,
+            "quarantine": {repr(k): sorted(v) for k, v in
+                           rt.guard._quarantine.items()},
+            "ladder": rt.ladder.snapshot(),
+            "faults": rt.plan.summary() if rt.plan is not None else None,
+            "checkpoint": ck_meta,
+            "resil_metrics": get_metrics().values("route.resil."),
+        }
+        path = os.path.join(diag_dir, f"{job.job_id}.diag.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, indent=1, default=str)
+        os.replace(tmp, path)
+        get_metrics().counter("route.resil.diag_bundles").inc()
+        return path

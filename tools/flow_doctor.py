@@ -308,7 +308,18 @@ def check_corpus(runs_dir: str, scenario, nets_tol: float,
                  f"bench append its row?)"], [])
     errs, notes = [], []
     for name in names:
-        records = rs.read_runs(runs_dir, name)
+        reader = getattr(rs, "read_runs_ex", None)
+        if reader is not None:
+            import warnings
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                records, skipped = reader(runs_dir, name)
+            if skipped:
+                notes.append(f"corpus[{name}]: skipped {skipped} "
+                             f"corrupted/torn JSONL line(s) (counted, "
+                             f"non-fatal — see obs/runstore.py)")
+        else:
+            records = rs.read_runs(runs_dir, name)
         if not records:
             errs.append(f"corpus[{name}]: no records "
                         f"(missing or all-invalid "
@@ -333,6 +344,74 @@ def check_corpus(runs_dir: str, scenario, nets_tol: float,
         se, sn = check_corpus_scenario(rs, records, nets_tol, k)
         errs += [f"corpus[{name}]: {e}" for e in se]
         notes += [f"corpus[{name}]: {n}" for n in sn]
+    return errs, notes
+
+
+def check_resil(doc: dict) -> tuple:
+    """Resil rule set over a serve summary JSON (serve/cli.py with the
+    resilience layer armed).  Returns (errors, notes).  The rules
+    catch a recovery layer that is lying or unbounded:
+
+      * quarantine without a matching cause (no injection, watchdog
+        timeout, or dispatch error) — a healthy variant was
+        blacklisted;
+      * degradation steps without a cause — the ladder moved on its
+        own;
+      * retries above the published retry budget (retry_cap x
+        observed causes) — unbounded retry loop;
+      * retries without any backoff — a hot retry loop;
+      * a terminal failed/timeout job with no failure_reason — the
+        poison-job contract (diagnosable terminal states) broke.
+    """
+    errs, notes = [], []
+    resil = doc.get("resil")
+    if not isinstance(resil, dict):
+        return (["serve-summary: no resil section (summary predates "
+                 "the resilience layer, or it was not armed)"], notes)
+    vals = resil.get("metrics") or {}
+
+    def g(k):
+        return vals.get("route.resil." + k) or 0
+
+    inj = g("injections")
+    wdt = g("watchdog_timeouts")
+    derr = g("dispatch_errors")
+    causes = inj + wdt + derr
+    q = g("quarantined_variants")
+    ret = g("retries")
+    cap = g("retry_cap")
+    deg = g("degradation_steps")
+    if q and not causes:
+        errs.append(f"resil: {q} quarantined variant(s) without any "
+                    f"matching injection, watchdog timeout, or "
+                    f"dispatch error — a healthy variant was "
+                    f"blacklisted")
+    if deg and not causes:
+        errs.append(f"resil: {deg} degradation step(s) without any "
+                    f"recorded cause")
+    if ret:
+        if not cap:
+            errs.append(f"resil: {ret} retries recorded but no "
+                        f"retry_cap gauge published — the retry "
+                        f"policy is unbounded")
+        elif ret > causes * cap:
+            errs.append(f"resil: unbounded retries: {ret} > "
+                        f"{causes} cause(s) x retry_cap {cap}")
+        if ret > 1 and g("backoff_ms") <= 0:
+            errs.append(f"resil: {ret} retries with zero total "
+                        f"backoff — hot retry loop")
+    for j in doc.get("jobs") or []:
+        if (j.get("state") in ("failed", "timeout")
+                and not j.get("failure_reason")):
+            errs.append(f"resil: job {j.get('job_id')} is terminal "
+                        f"{j.get('state')} without a failure_reason")
+    faults = resil.get("faults") or {}
+    notes.append(f"resil: injections={inj} timeouts={wdt} "
+                 f"errors={derr} retries={ret} quarantined={q} "
+                 f"degradations={deg} "
+                 f"kinds_fired={faults.get('kinds_fired', 0)} "
+                 f"checkpoints w/r={g('checkpoint_writes')}/"
+                 f"{g('checkpoint_recoveries')}")
     return errs, notes
 
 
@@ -367,12 +446,17 @@ def main(argv=None) -> int:
     ap.add_argument("--corpus-k", type=int, default=5,
                     help="trajectory window: median of the last K "
                          "same-backend rows (default %(default)s)")
+    ap.add_argument("--serve-summary", dest="serve_summary",
+                    help="serve CLI summary JSON to gate with the "
+                         "resil rule set (quarantine provenance, "
+                         "retry bounds, failure diagnosability)")
     args = ap.parse_args(argv)
 
     if not any((args.trace, args.metrics, args.devprof, args.row,
-                args.corpus)):
+                args.corpus, args.serve_summary)):
         ap.error("nothing to check: give at least one of --trace / "
-                 "--metrics / --devprof / --row / --corpus")
+                 "--metrics / --devprof / --row / --corpus / "
+                 "--serve-summary")
 
     errs, notes = [], []
     try:
@@ -426,6 +510,10 @@ def main(argv=None) -> int:
                                   args.nets_tol, args.corpus_k)
             errs += ce
             notes += cn
+        if args.serve_summary:
+            se, sn = check_resil(_read_json(args.serve_summary))
+            errs += se
+            notes += sn
     except (OSError, json.JSONDecodeError) as e:
         print(f"flow doctor: cannot read artifact: {e}",
               file=sys.stderr)
