@@ -1,0 +1,54 @@
+"""graft-lint: an AST-based static analyzer for this repo's JAX
+invariants — donation safety, dispatch-signature drift, determinism,
+durable-write atomicity, and the metric-name registry.
+
+Stdlib-only (no jax import) so it runs in the CI lint job and inside
+``flow_doctor --lint`` on a bare host.  See OBSERVABILITY.md for the
+rule catalogue, suppression syntax, and the baseline workflow.
+
+Public API::
+
+    from parallel_eda_tpu.analysis import lint_tree, lint_project
+    result = lint_tree("/path/to/repo")          # LintResult
+    result = lint_project({"m.py": source})      # fixture projects
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Optional
+
+from parallel_eda_tpu.analysis.core import (  # noqa: F401
+    DEFAULT_TARGETS, Finding, LintResult, ModuleCtx, Project, Rule,
+    all_rules, run_lint)
+
+#: repo-relative location of the committed baseline
+BASELINE_RELPATH = os.path.join("parallel_eda_tpu", "analysis",
+                                "baseline.json")
+
+
+def lint_project(sources: Dict[str, str],
+                 docs: Optional[Dict[str, str]] = None,
+                 rules: Optional[Iterable[str]] = None,
+                 baseline: Optional[dict] = None) -> LintResult:
+    """Lint an in-memory {relpath: source} project (fixture tests)."""
+    return run_lint(Project.from_sources(sources, docs=docs),
+                    rules=rules, baseline=baseline)
+
+
+def lint_tree(root: str, rules: Optional[Iterable[str]] = None,
+              baseline_path: Optional[str] = None,
+              use_baseline: bool = True) -> LintResult:
+    """Lint the on-disk tree rooted at ``root``.
+
+    ``baseline_path=None`` with ``use_baseline=True`` loads the
+    committed baseline at :data:`BASELINE_RELPATH` if present.
+    """
+    project = Project.from_tree(root)
+    baseline = None
+    if use_baseline:
+        from parallel_eda_tpu.analysis.baseline import load_baseline
+        path = baseline_path or os.path.join(root, BASELINE_RELPATH)
+        if os.path.isfile(path):
+            baseline = load_baseline(path)
+    return run_lint(project, rules=rules, baseline=baseline)

@@ -1,0 +1,72 @@
+"""Committed baseline of grandfathered graft-lint findings.
+
+Each entry matches on ``(rule, path, key)`` — never line numbers, so
+unrelated edits to a file don't invalidate it — and MUST carry a
+non-empty ``justification`` explaining why the finding is deliberate.
+``--write-baseline`` emits entries with an empty justification and the
+check mode refuses to pass until a human fills them in: grandfathering
+is an explicit, reviewed act, not a default.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from parallel_eda_tpu.analysis.core import Finding
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a graft-lint baseline file")
+    return data
+
+
+def make_baseline(findings: List[Finding]) -> dict:
+    return {
+        "version": BASELINE_VERSION,
+        "entries": [
+            {"rule": f.rule, "path": f.path, "key": f.key,
+             "justification": ""}
+            for f in sorted(findings, key=lambda f: (f.path, f.rule, f.key))
+        ],
+    }
+
+
+def dump_baseline(baseline: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], baseline: dict
+                   ) -> Tuple[List[Finding], List[Finding], List[dict],
+                              List[str]]:
+    """Split findings into (live, baselined); also report stale entries
+    and entries with missing justifications."""
+    entries = baseline.get("entries", [])
+    index: Dict[Tuple[str, str, str], dict] = {}
+    errors: List[str] = []
+    for e in entries:
+        k = (e.get("rule", ""), e.get("path", ""), e.get("key", ""))
+        index[k] = e
+        if not str(e.get("justification", "")).strip():
+            errors.append(
+                f"baseline entry {e.get('rule')}:{e.get('path')}:"
+                f"{e.get('key')} has no justification")
+    live: List[Finding] = []
+    baselined: List[Finding] = []
+    used = set()
+    for f in findings:
+        k = (f.rule, f.path, f.key)
+        if k in index:
+            baselined.append(f)
+            used.add(k)
+        else:
+            live.append(f)
+    unused = [e for k, e in sorted(index.items()) if k not in used]
+    return live, baselined, unused, errors

@@ -1,0 +1,52 @@
+"""Human and JSON reporters for graft-lint results."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from parallel_eda_tpu.analysis.core import LintResult
+
+
+def format_text(result: LintResult, verbose: bool = False) -> str:
+    out: List[str] = []
+    for f in result.findings:
+        out.append(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+    for err in result.baseline_errors:
+        out.append(f"baseline: {err}")
+    if verbose:
+        for f in result.suppressed:
+            out.append(f"{f.path}:{f.line}: [{f.rule}] suppressed inline: "
+                       f"{f.message}")
+        for f in result.baselined:
+            out.append(f"{f.path}:{f.line}: [{f.rule}] baselined: "
+                       f"{f.message}")
+    for e in result.unused_baseline:
+        out.append(f"note: stale baseline entry {e.get('rule')}:"
+                   f"{e.get('path')}:{e.get('key')} (no longer fires)")
+    n = len(result.findings)
+    out.append(
+        f"graft-lint: {n} finding{'s' if n != 1 else ''}, "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.baseline_errors)} baseline error(s) "
+        f"[rules: {', '.join(result.rules_run)}]")
+    return "\n".join(out)
+
+
+def to_json(result: LintResult) -> dict:
+    return {
+        "ok": result.ok,
+        "rules_run": result.rules_run,
+        "findings": [f.as_dict() for f in result.findings],
+        "suppressed": [f.as_dict() for f in result.suppressed],
+        "baselined": [f.as_dict() for f in result.baselined],
+        "unused_baseline": result.unused_baseline,
+        "baseline_errors": result.baseline_errors,
+    }
+
+
+def dump_json(result: LintResult, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_json(result), f, indent=2, sort_keys=True)
+        f.write("\n")

@@ -1,0 +1,225 @@
+"""Determinism rules: unordered iteration into hash/signature paths,
+and unseeded global RNG use.
+
+PR-8's replay contract is that every signature, corpus key, and export
+derives from sha256 over *sorted* inputs, so two processes (or two
+hosts) agree bit-for-bit.  A ``set`` comprehension feeding a hash, or
+``json.dumps`` without ``sort_keys=True`` inside a digest, silently
+breaks that — the output is *usually* stable on one interpreter and
+never stable across PYTHONHASHSEED domains.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from parallel_eda_tpu.analysis.core import Finding, Project, Rule, register
+from parallel_eda_tpu.analysis.rules_jax import _dotted
+
+#: calls that consume an iterable without exposing its order
+NEUTRALIZERS = {"sorted", "len", "min", "max", "sum", "any", "all",
+                "set", "frozenset"}
+UNORDERED_METHODS = {"keys", "values", "items"}
+HASH_CTORS = {"sha256", "sha1", "sha512", "md5", "blake2b", "blake2s",
+              "new"}
+
+
+def iter_funcs_with_scope(tree: ast.Module
+                          ) -> Iterator[Tuple[str, ast.AST]]:
+    """Yield (enclosing function name or '<module>', node) pairs."""
+    def walk(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child.name, child
+                yield from walk(child, child.name)
+            else:
+                yield scope, child
+                yield from walk(child, scope)
+    yield from walk(tree, "<module>")
+
+
+def find_unordered(node: ast.AST) -> List[Tuple[ast.AST, str]]:
+    """Unordered-iteration expressions in ``node`` that are NOT wrapped
+    in an order-neutralizing call (sorted/len/min/...)."""
+    out: List[Tuple[ast.AST, str]] = []
+
+    def visit(n):
+        if isinstance(n, ast.Call):
+            fname = n.func.id if isinstance(n.func, ast.Name) else None
+            if fname in ("set", "frozenset"):
+                out.append((n, f"{fname}()"))
+                return
+            if fname in NEUTRALIZERS:
+                return  # order is destroyed or re-imposed inside
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in UNORDERED_METHODS and not n.args \
+                    and not (isinstance(n.func.value, ast.Name)
+                             and n.func.value.id in ("self", "cls")):
+                # self.values() etc. is a method call, not dict iteration
+                out.append((n, f".{n.func.attr}()"))
+        if isinstance(n, (ast.Set, ast.SetComp)):
+            out.append((n, "set literal"))
+        if isinstance(n, ast.DictComp):
+            # a dict comp re-keys; its own iteration source matters
+            pass
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(node)
+    return out
+
+
+def _dumps_without_sort(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    if not d.endswith(("json.dumps", "json.dump")) \
+            and d not in ("dumps", "dump"):
+        return False
+    for kw in call.keywords:
+        if kw.arg == "sort_keys" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return False
+    return True
+
+
+@register
+class NondetIter(Rule):
+    id = "nondet-iter"
+    doc = ("unsorted set/dict iteration (or json.dumps without "
+           "sort_keys=True) flowing into hashing, signature, or "
+           "corpus/export paths — breaks cross-process replay")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for path, mod in sorted(project.modules.items()):
+            if mod.tree is None:
+                continue
+            hash_vars = self._hash_assignments(mod.tree)
+            for scope, node in iter_funcs_with_scope(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                findings.extend(
+                    self._check_sink(path, scope, node, hash_vars))
+        return findings
+
+    @staticmethod
+    def _hash_assignments(tree) -> Dict[str, str]:
+        """names assigned from hashlib.* calls (function-insensitive —
+        good enough for lint)."""
+        out: Dict[str, str] = {}
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                d = _dotted(n.value.func)
+                if d.startswith("hashlib."):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = d
+        return out
+
+    def _sink_desc(self, call: ast.Call,
+                   hash_vars: Dict[str, str]) -> Optional[str]:
+        d = _dotted(call.func)
+        if d.startswith("hashlib.") and d.split(".")[-1] in HASH_CTORS:
+            return d
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "update" \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id in hash_vars:
+            return f"{call.func.value.id}.update"
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "join" \
+                and isinstance(call.func.value, ast.Constant) \
+                and isinstance(call.func.value.value, str):
+            return "str.join"
+        return None
+
+    def _check_sink(self, path, scope, call, hash_vars) -> List[Finding]:
+        findings: List[Finding] = []
+        sink = self._sink_desc(call, hash_vars)
+        if sink is not None:
+            for sub, desc in self._arg_unordered(call):
+                findings.append(Finding(
+                    self.id, path, sub.lineno,
+                    f"{desc} iterated into {sink}() without sorted() — "
+                    f"the digest/signature depends on hash-table order "
+                    f"and is not reproducible across processes",
+                    key=f"{scope}:{sink}:{desc}"))
+            # the PR-8 invariant: json inside a hash must sort its keys
+            for a in call.args:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Call) \
+                            and _dumps_without_sort(sub):
+                        findings.append(Finding(
+                            self.id, path, sub.lineno,
+                            f"json.dumps(...) without sort_keys=True "
+                            f"feeding {sink}() — signatures must derive "
+                            f"from sha256 over sorted inputs",
+                            key=f"{scope}:{sink}:dumps"))
+        elif _dumps_without_sort(call):
+            for sub, desc in self._arg_unordered(call):
+                findings.append(Finding(
+                    self.id, path, sub.lineno,
+                    f"{desc} inside json.dumps/json.dump without "
+                    f"sort_keys=True — exported order is nondeterministic",
+                    key=f"{scope}:dumps:{desc}"))
+        return findings
+
+    @staticmethod
+    def _arg_unordered(call: ast.Call):
+        out = []
+        for a in list(call.args) + [kw.value for kw in call.keywords
+                                    if kw.arg != "sort_keys"]:
+            out.extend(find_unordered(a))
+        return out
+
+
+#: module-level np.random functions that use the unseeded global RNG
+NP_GLOBAL = {"rand", "randn", "randint", "random", "choice", "shuffle",
+             "permutation", "uniform", "normal", "sample",
+             "random_sample"}
+PY_GLOBAL = {"random", "randint", "randrange", "choice", "choices",
+             "shuffle", "sample", "uniform", "gauss", "betavariate",
+             "expovariate", "getrandbits"}
+#: constructors that are fine WITH a seed argument, flagged without
+SEEDABLE_CTORS = {"default_rng", "RandomState", "Random"}
+
+
+@register
+class UnseededRandom(Rule):
+    id = "unseeded-random"
+    doc = ("random.* / np.random.* without an explicit seed in non-test "
+           "code — every stochastic stage must be replayable")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for path, mod in sorted(project.modules.items()):
+            if mod.tree is None:
+                continue
+            for scope, node in iter_funcs_with_scope(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                parts = d.split(".")
+                if len(parts) < 2:
+                    continue
+                head, tail = ".".join(parts[:-1]), parts[-1]
+                is_np = head in ("np.random", "numpy.random",
+                                 "jnp.random")
+                is_py = head == "random"
+                if not (is_np or is_py):
+                    continue
+                if tail in SEEDABLE_CTORS:
+                    if not node.args and not node.keywords:
+                        findings.append(Finding(
+                            self.id, path, node.lineno,
+                            f"{d}() constructed without a seed — pass an "
+                            f"explicit seed so the run is replayable",
+                            key=f"{scope}:{d}"))
+                elif (is_np and tail in NP_GLOBAL) \
+                        or (is_py and tail in PY_GLOBAL):
+                    findings.append(Finding(
+                        self.id, path, node.lineno,
+                        f"{d}() uses the unseeded global RNG — use a "
+                        f"seeded random.Random(seed) / "
+                        f"np.random.default_rng(seed) instance",
+                        key=f"{scope}:{d}"))
+        return findings

@@ -1,0 +1,168 @@
+"""Durability / degradation-path rules.
+
+The repo's crash-safety contract (PR-7/PR-8) is that every durable
+artifact — corpus rows under ``runs/``, checkpoints, the AOT library
+index — is written either via the single-``os.write`` O_APPEND helper
+in ``obs/runstore.py`` or via the tmp + fsync + ``os.replace`` dance
+in ``resil/checkpoint.py`` / ``serve/library.py``.  A plain
+``open(path, "w")`` to one of those paths can tear under the chaos
+suite's kill points.  Likewise the resil/serve degrade paths may only
+swallow exceptions if they record *why* (a counter, a log line, or at
+minimum binding the exception) — a silent ``except Exception: pass``
+turns a diagnosable fault into a heisenbug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from parallel_eda_tpu.analysis.core import Finding, Project, Rule, register
+from parallel_eda_tpu.analysis.rules_determinism import iter_funcs_with_scope
+from parallel_eda_tpu.analysis.rules_jax import _dotted
+
+#: substrings identifying a durable-artifact path
+DURABLE_MARKERS = ("runs", ".jsonl", "library.json", "checkpoint", ".ck")
+
+
+def _string_parts(node: ast.AST, local: Dict[str, ast.AST],
+                  depth: int = 0) -> List[str]:
+    """All string constants reachable in a path expression, resolving
+    simple local assignments one hop (``tmp = path + ".tmp"``)."""
+    if depth > 3 or node is None:
+        return []
+    out: List[str] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append(n.value)
+        elif isinstance(n, ast.Name) and n.id in local:
+            resolved = local[n.id]
+            if resolved is not node:
+                out.extend(_string_parts(resolved, {}, depth + 1))
+    return out
+
+
+@register
+class NonatomicWrite(Rule):
+    id = "nonatomic-write"
+    doc = ("open(..., 'w'/'a') to runs/, checkpoint, or library-index "
+           "paths bypassing the atomic tmp+fsync+rename / O_APPEND "
+           "helpers in obs/runstore.py and resil/checkpoint.py")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for path, mod in sorted(project.modules.items()):
+            if mod.tree is None:
+                continue
+            for fn in ast.walk(mod.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(self._check_func(path, fn))
+        return findings
+
+    def _check_func(self, path: str, fn) -> List[Finding]:
+        has_replace = False
+        local: Dict[str, ast.AST] = {}
+        opens: List[ast.Call] = []
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d in ("os.replace", "os.rename"):
+                    has_replace = True
+                elif isinstance(n.func, ast.Name) and n.func.id == "open" \
+                        and n.args:
+                    opens.append(n)
+            elif isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                local[n.targets[0].id] = n.value
+        findings: List[Finding] = []
+        for call in opens:
+            mode = "r"
+            if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+                mode = str(call.args[1].value)
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if not any(c in mode for c in "wax"):
+                continue
+            parts = _string_parts(call.args[0], local)
+            markers = sorted({m for m in DURABLE_MARKERS
+                              for p in parts if m in p})
+            if not markers:
+                continue
+            if any(".tmp" in p for p in parts):
+                continue  # tmp half of the atomic rename dance
+            if has_replace:
+                continue  # same function finishes with os.replace/rename
+            findings.append(Finding(
+                self.id, path, call.lineno,
+                f"open(..., {mode!r}) writes a durable path (markers: "
+                f"{', '.join(markers)}) without tmp+os.replace or the "
+                f"O_APPEND helper — a crash mid-write tears the artifact",
+                key=f"{fn.name}:{':'.join(markers)}"))
+        return findings
+
+
+#: attribute calls in a handler body that count as recording the fault
+RECORDING_ATTRS = {"inc", "warn", "warning", "error", "exception", "log",
+                   "instant", "counter", "mark", "record", "add", "set",
+                   "debug", "info"}
+
+
+@register
+class BareExceptSwallow(Rule):
+    id = "bare-except-swallow"
+    doc = ("bare except / except Exception in resil/serve degrade paths "
+           "that neither re-raises, records a reason counter, nor binds "
+           "the exception — faults must stay diagnosable")
+
+    SCOPES = ("parallel_eda_tpu/resil/", "parallel_eda_tpu/serve/")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for path, mod in sorted(project.modules.items()):
+            if mod.tree is None:
+                continue
+            if not any(path.startswith(s) for s in self.SCOPES):
+                continue
+            counters: Dict[str, int] = {}
+            for scope, node in iter_funcs_with_scope(mod.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not self._is_broad(node.type):
+                    continue
+                idx = counters.get(scope, 0)
+                counters[scope] = idx + 1
+                if self._records(node):
+                    continue
+                findings.append(Finding(
+                    self.id, path, node.lineno,
+                    f"broad except in {scope}() swallows the fault without "
+                    f"recording a reason (no counter/log/raise and the "
+                    f"exception is never bound) — degrade paths must stay "
+                    f"diagnosable",
+                    key=f"{scope}:{idx}"))
+        return findings
+
+    @staticmethod
+    def _is_broad(t) -> bool:
+        if t is None:
+            return True
+        names = []
+        for n in ([t] if not isinstance(t, ast.Tuple) else t.elts):
+            if isinstance(n, ast.Name):
+                names.append(n.id)
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @staticmethod
+    def _records(handler: ast.ExceptHandler) -> bool:
+        exc_name = handler.name
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in RECORDING_ATTRS:
+                return True
+            if exc_name and isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, ast.Load) and n.id == exc_name:
+                return True
+        return False

@@ -1,0 +1,507 @@
+"""JAX-invariant rules: donation safety, jit-signature drift, pipeline
+sync discipline.
+
+These encode the two expensive lessons of PRs 4 and 5: donating a
+buffer into an in-flight execution and then dropping / rebinding its
+last Python reference blocks the host until the execution retires (the
+"donated-buffer graveyard"), and a host-side sync inside the pipelined
+window loop collapses the host/device overlap the router exists to
+create.  The analysis is intraprocedural and deliberately heuristic —
+it trades soundness for zero false noise on idiomatic code, and every
+sanctioned violation is annotated in place with
+``# graftlint: ignore[...]`` so the exceptions are greppable.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from parallel_eda_tpu.analysis.core import Finding, Project, Rule, register
+
+RETIRE_RE = re.compile(r"retire|graveyard|park|keep", re.IGNORECASE)
+
+#: canonical device-resident state names in the pipelined window loop
+DEVICE_STATE_NAMES = {
+    "occ", "acc", "paths", "sink_delay", "all_reached", "bb", "crit_d",
+    "fin_save", "out", "o", "outs",
+}
+
+
+def _last_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('jax.jit', 'np.asarray')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return _dotted(node.value) + "." + node.attr
+    return ""
+
+
+def _module_const_tuples(tree: ast.Module) -> Dict[str, List[str]]:
+    """Module-level NAME = ("a", "b", ...) string-tuple constants."""
+    out: Dict[str, List[str]] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            vals = []
+            for el in stmt.value.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    vals.append(el.value)
+                else:
+                    break
+            else:
+                out[stmt.targets[0].id] = vals
+    return out
+
+
+def _resolve_argnames(node: ast.AST,
+                      consts: Dict[str, List[str]]) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                vals.append(el.value)
+            else:
+                return None
+        return vals
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _jit_keywords(node: ast.AST) -> Optional[List[ast.keyword]]:
+    """Keywords of a jit decoration, or None if ``node`` isn't one.
+
+    Recognised shapes::
+
+        @jax.jit                                  -> []
+        @jax.jit(...)                             (rare; jit takes fn first)
+        @functools.partial(jax.jit, static_argnames=..., donate_argnames=...)
+        functools.partial(jax.jit, ...)(fn)       (direct application)
+    """
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        d = _dotted(node)
+        return [] if d in ("jit", "jax.jit") else None
+    if isinstance(node, ast.Call):
+        fd = _dotted(node.func)
+        if fd in ("jit", "jax.jit"):
+            return list(node.keywords)
+        if fd.endswith("partial") and node.args \
+                and _dotted(node.args[0]) in ("jit", "jax.jit"):
+            return list(node.keywords)
+    return None
+
+
+class JitSite:
+    """One jit-wrapped function: exposed name(s), params, argnames."""
+
+    def __init__(self, path: str, line: int, names: List[str],
+                 params: List[str], statics: Optional[List[str]],
+                 donated: Optional[List[str]],
+                 unresolved: List[str]):
+        self.path = path
+        self.line = line
+        self.names = names
+        self.params = params
+        self.statics = statics or []
+        self.donated = donated or []
+        self.unresolved = unresolved  # keyword names we could not resolve
+
+
+def _params_of(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+def collect_jit_sites(project: Project) -> List[JitSite]:
+    sites: List[JitSite] = []
+    for path, mod in sorted(project.modules.items()):
+        if mod.tree is None:
+            continue
+        consts = _module_const_tuples(mod.tree)
+        funcs = {n.name: n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for fn in funcs.values():
+            for deco in fn.decorator_list:
+                kws = _jit_keywords(deco)
+                if kws is None:
+                    continue
+                sites.append(_make_site(path, fn.lineno, [fn.name],
+                                        _params_of(fn), kws, consts))
+        # application form: name = functools.partial(jax.jit, ...)(fn)
+        for stmt in mod.tree.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            call = stmt.value
+            kws = _jit_keywords(call.func)
+            if kws is None or not call.args:
+                continue
+            inner = call.args[0]
+            if isinstance(inner, ast.Name) and inner.id in funcs:
+                wrapped = funcs[inner.id]
+                sites.append(_make_site(
+                    path, stmt.lineno,
+                    [stmt.targets[0].id, wrapped.name],
+                    _params_of(wrapped), kws, consts))
+    return sites
+
+
+def _make_site(path: str, line: int, names: List[str], params: List[str],
+               kws: List[ast.keyword], consts: Dict[str, List[str]]
+               ) -> JitSite:
+    statics = donated = None
+    unresolved: List[str] = []
+    for kw in kws:
+        if kw.arg in ("static_argnames", "donate_argnames"):
+            vals = _resolve_argnames(kw.value, consts)
+            if vals is None:
+                unresolved.append(kw.arg)
+            elif kw.arg == "static_argnames":
+                statics = vals
+            else:
+                donated = vals
+    return JitSite(path, line, names, params, statics, donated, unresolved)
+
+
+@register
+class DonateSigDrift(Rule):
+    id = "donate-sig-drift"
+    doc = ("every static_argnames/donate_argnames entry must name a real "
+           "parameter of the wrapped function, and WINDOW_STATIC_ARGNAMES "
+           "must have exactly one definition (route/planes.py)")
+
+    CANON = "WINDOW_STATIC_ARGNAMES"
+    CANON_HOME = "route/planes.py"
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for site in collect_jit_sites(project):
+            params = set(site.params)
+            for kind, vals in (("static_argnames", site.statics),
+                               ("donate_argnames", site.donated)):
+                for name in vals:
+                    if name not in params:
+                        findings.append(Finding(
+                            self.id, site.path, site.line,
+                            f"{kind} entry {name!r} is not a parameter of "
+                            f"{site.names[0]}() — signature drift; the jit "
+                            f"call will raise (or silently retrace) at "
+                            f"runtime",
+                            key=f"{site.names[0]}:{name}"))
+        # WINDOW_STATIC_ARGNAMES must have one home; shadow copies drift
+        defs: List[Tuple[str, int]] = []
+        for path, mod in sorted(project.modules.items()):
+            if mod.tree is None:
+                continue
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) \
+                        and any(isinstance(t, ast.Name) and t.id == self.CANON
+                                for t in stmt.targets):
+                    defs.append((path, stmt.lineno))
+        homes = [d for d in defs if d[0].endswith(self.CANON_HOME)]
+        if homes:
+            for path, line in defs:
+                if (path, line) in homes:
+                    continue
+                findings.append(Finding(
+                    self.id, path, line,
+                    f"shadow definition of {self.CANON} — the window-static "
+                    f"contract lives in {self.CANON_HOME}; import it instead "
+                    f"of copying so the AOT library and devprof avatars "
+                    f"cannot drift",
+                    key=f"shadow:{path}"))
+        return findings
+
+
+@register
+class UseAfterDonate(Rule):
+    id = "use-after-donate"
+    doc = ("reads/rebinds of names passed into jax.jit(donate_argnames=...) "
+           "calls after dispatch, without parking them in a retire list "
+           "(the PR-4 donated-buffer graveyard)")
+
+    def check(self, project: Project) -> List[Finding]:
+        donators: Dict[str, JitSite] = {}
+        for site in collect_jit_sites(project):
+            if site.donated:
+                for n in site.names:
+                    donators[n] = site
+        findings: List[Finding] = []
+        for path, mod in sorted(project.modules.items()):
+            if mod.tree is None:
+                continue
+            for fn in ast.walk(mod.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(
+                        self._check_func(path, fn, donators))
+        return findings
+
+    # -- per-function linear dataflow ---------------------------------
+
+    def _check_func(self, path, fn, donators) -> List[Finding]:
+        self._findings: List[Finding] = []
+        self._tainted: Dict[str, str] = {}   # name -> donor callee
+        self._parked: set = set()
+        self._path = path
+        self._donators = donators
+        for stmt in fn.body:
+            self._visit_stmt(stmt)
+        return self._findings
+
+    def _visit_stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes run later; out of this rule's reach
+        if self._handle_retire_append(stmt):
+            return
+        # compound statements: process only the header expression here,
+        # then recurse — the body statements must see taint in order
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._process(stmt.test, set())
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._process(stmt.iter, self._store_targets(stmt))
+            for s in stmt.body + stmt.orelse:
+                self._visit_stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._process(item.context_expr, set())
+            for s in stmt.body:
+                self._visit_stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._visit_stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._visit_stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._visit_stmt(s)
+            return
+        self._process(stmt, self._store_targets(stmt))
+
+    def _process(self, node, targets) -> None:
+        """Reads/stores/donations of one simple statement or header expr."""
+        donated_here, donation_args = self._donating_calls(node)
+        self._check_reads(node, exempt=donation_args)
+        self._check_stores(node, targets)
+        for name, callee in donated_here:
+            if name in targets:
+                # same-statement rebind: x, ... = f(x, ...) — the old
+                # buffer's last reference drops while f may be in flight
+                if name not in self._parked:
+                    self._findings.append(Finding(
+                        self.id, self._path, node.lineno,
+                        f"{name!r} is donated into {callee}() and rebound "
+                        f"in the same statement without being parked in a "
+                        f"retire list first — dropping the last reference "
+                        f"to an in-flight donated buffer blocks the host "
+                        f"(PR-4 graveyard)",
+                        key=f"rebind:{callee}:{name}"))
+                self._parked.discard(name)
+                self._tainted.pop(name, None)
+            else:
+                self._tainted[name] = callee
+                self._parked.discard(name)
+
+    def _handle_retire_append(self, stmt) -> bool:
+        """retire.append(x) / graveyard.append((a, b)) parks its names."""
+        if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "append"
+                and isinstance(stmt.value.func.value, ast.Name)
+                and RETIRE_RE.search(stmt.value.func.value.id)):
+            return False
+        for node in ast.walk(stmt.value):
+            if isinstance(node, ast.Name) and node.id in self._tainted:
+                self._parked.add(node.id)
+        return True
+
+    def _donating_calls(self, stmt):
+        """(donated simple-Name args, all arg names of those calls)."""
+        donated: List[Tuple[str, str]] = []
+        arg_names: set = set()
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _last_name(node.func)
+            site = self._donators.get(callee or "")
+            if site is None:
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue  # cannot map positions through *args
+            bound: Dict[str, ast.AST] = {}
+            for i, a in enumerate(node.args):
+                if i < len(site.params):
+                    bound[site.params[i]] = a
+            for kw in node.keywords:
+                if kw.arg:
+                    bound[kw.arg] = kw.value
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    arg_names.add(a.id)
+            for kw in node.keywords:
+                if isinstance(kw.value, ast.Name):
+                    arg_names.add(kw.value.id)
+            for p in site.donated:
+                a = bound.get(p)
+                if isinstance(a, ast.Name):
+                    donated.append((a.id, callee))
+        return donated, arg_names
+
+    @staticmethod
+    def _store_targets(stmt) -> set:
+        targets: set = set()
+        tl = []
+        if isinstance(stmt, ast.Assign):
+            tl = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            tl = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            tl = [stmt.target]
+        for t in tl:
+            for node in ast.walk(t):
+                if isinstance(node, ast.Name):
+                    targets.add(node.id)
+        return targets
+
+    def _check_reads(self, stmt, exempt) -> None:
+        if not self._tainted:
+            return
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                    and node.id in self._tainted and node.id not in exempt:
+                callee = self._tainted.pop(node.id)
+                self._findings.append(Finding(
+                    self.id, self._path, node.lineno,
+                    f"{node.id!r} is read after being donated into "
+                    f"{callee}() — the buffer belongs to the executable "
+                    f"now; reading it is undefined (and on CPU forces a "
+                    f"sync)",
+                    key=f"read:{callee}:{node.id}"))
+
+    def _check_stores(self, stmt, targets) -> None:
+        for name in sorted(targets & set(self._tainted)):
+            callee = self._tainted.pop(name)
+            if name in self._parked:
+                self._parked.discard(name)
+                continue
+            self._findings.append(Finding(
+                self.id, self._path, stmt.lineno,
+                f"{name!r} is rebound after being donated into {callee}() "
+                f"without a retire-list park — the old buffer's last "
+                f"reference drops mid-flight (PR-4 graveyard)",
+                key=f"rebind:{callee}:{name}"))
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id in self._tainted:
+                    callee = self._tainted.pop(t.id)
+                    if t.id in self._parked:
+                        self._parked.discard(t.id)
+                        continue
+                    self._findings.append(Finding(
+                        self.id, self._path, stmt.lineno,
+                        f"del of {t.id!r} drops the last reference to a "
+                        f"buffer donated into {callee}() while it may "
+                        f"still be in flight",
+                        key=f"del:{callee}:{t.id}"))
+
+
+@register
+class PipelineSync(Rule):
+    id = "pipeline-sync"
+    doc = ("jax.device_get / jax.block_until_ready / np.asarray / float() "
+           "on device state inside a loop that streams results with "
+           "copy_to_host_async — each one stalls the host/device overlap")
+
+    SYNC_FULL = {"jax.device_get", "jax.block_until_ready"}
+    HOSTIFY = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get"}
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: set = set()
+        for path, mod in sorted(project.modules.items()):
+            if mod.tree is None:
+                continue
+            for loop in ast.walk(mod.tree):
+                if not isinstance(loop, (ast.While, ast.For)):
+                    continue
+                if not self._is_async_loop(loop):
+                    continue
+                for f in self._scan(path, loop):
+                    sig = (f.path, f.line, f.key)
+                    if sig not in seen:
+                        seen.add(sig)
+                        findings.append(f)
+        return findings
+
+    @staticmethod
+    def _is_async_loop(loop) -> bool:
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == "copy_to_host_async":
+                return True
+        return False
+
+    def _scan(self, path: str, loop) -> List[Finding]:
+        out: List[Finding] = []
+        skip_under: set = set()
+        for node in ast.walk(loop):
+            # don't descend into nested defs: they run at call time,
+            # possibly outside the loop
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not loop:
+                for sub in ast.walk(node):
+                    skip_under.add(id(sub))
+        for node in ast.walk(loop):
+            if id(node) in skip_under or not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d in self.SYNC_FULL:
+                out.append(Finding(
+                    self.id, path, node.lineno,
+                    f"{d}() inside the async window loop is a full host "
+                    f"sync — it stalls the pipeline; move it past the "
+                    f"loop or annotate the sanctioned sync point",
+                    key=f"{d}:{self._devname(node) or 'call'}"))
+            elif d in self.HOSTIFY or (isinstance(node.func, ast.Name)
+                                       and node.func.id == "float"):
+                name = self._devname(node)
+                if name:
+                    label = d or "float"
+                    out.append(Finding(
+                        self.id, path, node.lineno,
+                        f"{label}({name}...) inside the async window loop "
+                        f"forces a device sync on live pipeline state — "
+                        f"only the sanctioned stall/drain points may do "
+                        f"this",
+                        key=f"{label}:{name}"))
+        return out
+
+    @staticmethod
+    def _devname(call: ast.Call) -> Optional[str]:
+        for a in call.args:
+            for node in ast.walk(a):
+                if isinstance(node, ast.Name) \
+                        and node.id in DEVICE_STATE_NAMES:
+                    return node.id
+        return None

@@ -1,0 +1,206 @@
+"""Metric-name registry rule: code and OBSERVABILITY.md must agree.
+
+Every ``route.*`` / ``place.*`` / ``shard.*`` instrument name that the
+code registers (``counter()``/``gauge()``/``histogram()`` calls, dicts
+fed to ``set_gauges``) must appear in OBSERVABILITY.md — in a table
+row's first cell or a backticked bullet lead — and every documented
+name must still exist in code, so the docs cannot rot in either
+direction.  Dynamic name segments (f-string fields, ``+ k`` concats)
+become ``*`` wildcards on the code side and ``<placeholder>`` tokens
+become ``*`` on the doc side; a wildcard on either side matches.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from parallel_eda_tpu.analysis.core import Finding, Project, Rule, register
+
+METRIC_RE = re.compile(r"^(route|place|shard)\.[A-Za-z0-9_*.]*[A-Za-z0-9_*]$")
+PLACEHOLDER_RE = re.compile(r"<[^>]+>|\{[^}]+\}")
+BACKTICK_RE = re.compile(r"`([^`]+)`")
+DOC_NAME = "OBSERVABILITY.md"
+REGISTRY_CALLS = {"counter", "gauge", "histogram"}
+
+
+def _literal_names(node: ast.AST) -> List[str]:
+    """Like :func:`_literal_name` but follows both arms of a
+    conditional expression (``counter("a" if x else "b")``)."""
+    if isinstance(node, ast.IfExp):
+        return _literal_names(node.body) + _literal_names(node.orelse)
+    name = _literal_name(node)
+    return [name] if name is not None else []
+
+
+def _literal_name(node: ast.AST) -> Optional[str]:
+    """Metric-name string from a literal-ish expression, with dynamic
+    segments collapsed to '*'."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _literal_name(node.left)
+        if left is not None:
+            right = _literal_name(node.right)
+            return left + (right if right is not None else "*")
+    return None
+
+
+def _normalize(name: Optional[str]) -> Optional[str]:
+    if not name:
+        return None
+    name = PLACEHOLDER_RE.sub("*", name).strip()
+    name = re.sub(r"\.\*+", ".*", name)        # ".{t}." -> ".*."
+    name = re.sub(r"\.+$", "", name)           # "route.devcost." -> prefix
+    if not METRIC_RE.match(name):
+        return None
+    return name
+
+
+def collect_code_metrics(project: Project) -> Dict[str, Tuple[str, int]]:
+    """metric name -> first (path, line) that registers it."""
+    out: Dict[str, Tuple[str, int]] = {}
+
+    def add(name: Optional[str], path: str, line: int):
+        # a bare prefix from "route.devcost." + k means one dynamic tail
+        if name and name.endswith("."):
+            name += "*"
+        name = _normalize(name)
+        if name and name not in out:
+            out[name] = (path, line)
+
+    for path, mod in sorted(project.modules.items()):
+        if mod.tree is None:
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Module)):
+                continue
+            gauge_dicts = set()
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in REGISTRY_CALLS and n.args:
+                    for nm in _literal_names(n.args[0]):
+                        add(nm, path, n.args[0].lineno)
+                if isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "set_gauges" and n.args:
+                    a = n.args[0]
+                    if isinstance(a, ast.Dict):
+                        for k in a.keys:
+                            if k is not None:
+                                add(_literal_name(k), path, k.lineno)
+                    elif isinstance(a, ast.Name):
+                        gauge_dicts.add(a.id)
+            if not gauge_dicts:
+                continue
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign) \
+                        and any(isinstance(t, ast.Name)
+                                and t.id in gauge_dicts
+                                for t in n.targets) \
+                        and isinstance(n.value, ast.Dict):
+                    for k in n.value.keys:
+                        if k is not None:
+                            add(_literal_name(k), path, k.lineno)
+                elif isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in gauge_dicts:
+                            add(_literal_name(t.slice), path, t.lineno)
+    return out
+
+
+def collect_doc_metrics(doc: str) -> Dict[str, int]:
+    """metric name -> first doc line documenting it.
+
+    Parsed sources: first cells of markdown table rows, and bullet
+    lines beginning ``- `name```.  A bare token (``relax_steps_wasted``
+    or ``.wasted``) extends the previous full name ON THE SAME LINE by
+    replacing its last components — the docs' ``a` / `b`` row idiom.
+    """
+    out: Dict[str, int] = {}
+    for lineno, line in enumerate(doc.splitlines(), start=1):
+        stripped = line.strip()
+        if stripped.startswith("|"):
+            cells = stripped.split("|")
+            region = cells[1] if len(cells) > 1 else ""
+        elif re.match(r"^-\s+`", stripped):
+            region = stripped.split("—")[0].split(" -- ")[0]
+        else:
+            continue
+        prev_full: Optional[str] = None
+        for tok in BACKTICK_RE.findall(region):
+            tok = PLACEHOLDER_RE.sub("*", tok.strip())
+            tok = re.sub(r"\.\*+", ".*", tok)
+            name: Optional[str] = None
+            if re.match(r"^(route|place|shard)\.", tok):
+                name = tok
+            elif prev_full is not None and re.match(r"^[.A-Za-z0-9_*]+$",
+                                                    tok):
+                suffix = tok.lstrip(".")
+                sparts = suffix.split(".")
+                pparts = prev_full.split(".")
+                if len(sparts) < len(pparts):
+                    name = ".".join(pparts[:-len(sparts)] + sparts)
+            name = _normalize(name)
+            if name:
+                prev_full = name
+                if name not in out:
+                    out[name] = lineno
+    return out
+
+
+def _pattern_matches(a: str, b: str) -> bool:
+    """True if name/pattern ``a`` covers ``b`` or vice versa."""
+    if a == b:
+        return True
+    for pat, name in ((a, b), (b, a)):
+        if "*" in pat:
+            rx = "^" + ".*".join(re.escape(p) for p in pat.split("*")) + "$"
+            if re.match(rx, name):
+                return True
+    return False
+
+
+@register
+class MetricRegistry(Rule):
+    id = "metric-registry"
+    doc = ("every route.*/place.*/shard.* metric literal in code must "
+           "appear in OBSERVABILITY.md's tables, and vice versa")
+
+    def check(self, project: Project) -> List[Finding]:
+        doc = project.docs.get(DOC_NAME)
+        if doc is None:
+            return []  # nothing to reconcile against (fixture projects)
+        code = collect_code_metrics(project)
+        documented = collect_doc_metrics(doc)
+        findings: List[Finding] = []
+        for name, (path, line) in sorted(code.items()):
+            if not any(_pattern_matches(name, d) for d in documented):
+                findings.append(Finding(
+                    self.id, path, line,
+                    f"metric {name!r} is registered in code but absent "
+                    f"from {DOC_NAME}'s tables — document it (name, "
+                    f"type, meaning) or remove the instrument",
+                    key=name))
+        for name, line in sorted(documented.items()):
+            if not any(_pattern_matches(name, c) for c in code):
+                findings.append(Finding(
+                    self.id, DOC_NAME, line,
+                    f"documented metric {name!r} no longer exists in "
+                    f"code — stale row; delete it or restore the "
+                    f"instrument",
+                    key=f"doc:{name}"))
+        return findings

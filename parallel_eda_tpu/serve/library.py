@@ -174,7 +174,9 @@ def _provenance(repo_dir: Optional[str] = None) -> Dict[str, Any]:
     try:
         from ..obs.runstore import git_rev
         rev = git_rev(repo_dir)
-    except Exception:
+    except Exception:  # graftlint: ignore[bare-except-swallow]
+        # a checkout without git is an expected environment, not a
+        # degrade event; the recorded outcome IS rev=None in the stamp
         rev = None
     return {
         "schema": LIBRARY_SCHEMA,

@@ -39,6 +39,11 @@ Checks, each skipped (with a note) when its artifact is not given:
            exceed the trajectory median.  Cross-backend rows and
            pre_pr2 imports never enter the median; a scenario with no
            same-backend history skips with a note
+  lint     (--lint [--lint-root DIR]) the graft-lint static rule set
+           (parallel_eda_tpu/analysis): donation safety, jit-signature
+           drift, determinism, durable-write atomicity, metric-name
+           registry.  Any live finding (or a baseline entry missing
+           its justification) is UNHEALTHY
 
 Exit codes: 0 healthy, 1 regression / broken invariant, 2 usage or
 unreadable artifact.
@@ -415,6 +420,36 @@ def check_resil(doc: dict) -> tuple:
     return errs, notes
 
 
+def check_lint(root=None):
+    """Run the graft-lint static rule set (parallel_eda_tpu/analysis —
+    stdlib-only like this tool) over the source tree.  Every live
+    finding is an error; suppressed/baselined counts land in notes."""
+    errs, notes = [], []
+    repo = root or os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))
+    if not os.path.isdir(os.path.join(repo, "parallel_eda_tpu",
+                                      "analysis")):
+        return ([f"lint: no analysis package under {repo} — pass "
+                 f"--lint-root pointing at the repo checkout"], notes)
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from parallel_eda_tpu.analysis import lint_tree
+    result = lint_tree(repo)
+    for f in result.findings:
+        errs.append(f"lint: {f.path}:{f.line}: [{f.rule}] {f.message}")
+    for e in result.baseline_errors:
+        errs.append(f"lint: {e}")
+    notes.append(
+        f"lint: {len(result.rules_run)} rules over {repo}: "
+        f"{len(result.findings)} findings, "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined")
+    for e in result.unused_baseline:
+        notes.append(f"lint: stale baseline entry {e.get('rule')}:"
+                     f"{e.get('path')}:{e.get('key')}")
+    return errs, notes
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--trace", help="Chrome trace-event JSON to gate")
@@ -450,13 +485,21 @@ def main(argv=None) -> int:
                     help="serve CLI summary JSON to gate with the "
                          "resil rule set (quarantine provenance, "
                          "retry bounds, failure diagnosability)")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the graft-lint static rule set over the "
+                         "source tree (donation safety, signature "
+                         "drift, determinism, durable writes, metric "
+                         "registry); any live finding is UNHEALTHY")
+    ap.add_argument("--lint-root",
+                    help="repo root for --lint (default: this "
+                         "checkout)")
     args = ap.parse_args(argv)
 
     if not any((args.trace, args.metrics, args.devprof, args.row,
-                args.corpus, args.serve_summary)):
+                args.corpus, args.serve_summary, args.lint)):
         ap.error("nothing to check: give at least one of --trace / "
                  "--metrics / --devprof / --row / --corpus / "
-                 "--serve-summary")
+                 "--serve-summary / --lint")
 
     errs, notes = [], []
     try:
@@ -514,6 +557,10 @@ def main(argv=None) -> int:
             se, sn = check_resil(_read_json(args.serve_summary))
             errs += se
             notes += sn
+        if args.lint:
+            le, ln = check_lint(args.lint_root)
+            errs += le
+            notes += ln
     except (OSError, json.JSONDecodeError) as e:
         print(f"flow doctor: cannot read artifact: {e}",
               file=sys.stderr)

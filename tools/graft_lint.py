@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""graft-lint CLI — static analysis for this repo's JAX invariants.
+
+Stdlib-only; imports ``parallel_eda_tpu.analysis`` (which never imports
+jax) so it runs before any dependency install.  Exit codes:
+
+    0   clean (or everything suppressed/baselined with justification)
+    1   findings, or baseline entries missing justifications
+    2   usage / internal error
+
+Typical use::
+
+    python tools/graft_lint.py --check                 # CI gate
+    python tools/graft_lint.py --check --json out.json # + JSON report
+    python tools/graft_lint.py --list-rules
+    python tools/graft_lint.py --write-baseline        # grandfather,
+        # then fill in every "justification" by hand before committing
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_analysis():
+    # the package __init__ is import-light (no jax), so a plain path
+    # insert is safe even on hosts without the accelerator stack
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    import parallel_eda_tpu.analysis as analysis
+    return analysis
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graft_lint",
+        description="AST lint for donation safety, signature drift, "
+                    "determinism, durable writes, and the metric registry")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root to scan (default: this checkout)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any live finding (CI mode)")
+    ap.add_argument("--json", metavar="FILE",
+                    help="write the JSON report to FILE")
+    ap.add_argument("--rules", metavar="ID[,ID...]",
+                    help="run only these rule ids")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="baseline file (default: the committed one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show grandfathered too)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as a new baseline "
+                         "(justifications left empty for review)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also list suppressed and baselined findings")
+    args = ap.parse_args(argv)
+
+    analysis = _import_analysis()
+    from parallel_eda_tpu.analysis import baseline as bl
+    from parallel_eda_tpu.analysis import reporters
+
+    if args.list_rules:
+        for rid, rule in sorted(analysis.all_rules().items()):
+            print(f"{rid:22s} {rule.doc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    if args.write_baseline:
+        result = analysis.lint_tree(args.root, rules=rules,
+                                    use_baseline=False)
+        out = args.baseline or os.path.join(args.root,
+                                            analysis.BASELINE_RELPATH)
+        bl.dump_baseline(bl.make_baseline(result.findings), out)
+        print(f"graft-lint: wrote {len(result.findings)} entries to {out} "
+              f"— fill in every 'justification' before committing")
+        return 0
+
+    result = analysis.lint_tree(
+        args.root, rules=rules, baseline_path=args.baseline,
+        use_baseline=not args.no_baseline)
+    if args.json:
+        reporters.dump_json(result, args.json)
+    print(reporters.format_text(result, verbose=args.verbose))
+    if args.check:
+        return 0 if result.ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyError as e:
+        print(f"graft_lint: {e}", file=sys.stderr)
+        sys.exit(2)

@@ -77,8 +77,13 @@ def main():
             rec = {"row": row, "error": f"{type(e).__name__}: {e}",
                    "wall_s": round(time.time() - t0, 1)}
         print(json.dumps(rec), flush=True)
-        with open(out, "a") as fh:
-            fh.write(json.dumps(rec) + "\n")
+        # single O_APPEND write: a crash mid-row can't tear the ledger
+        # (same contract as obs/runstore.append_run)
+        fd = os.open(out, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, (json.dumps(rec) + "\n").encode("utf-8"))
+        finally:
+            os.close(fd)
 
 
 if __name__ == "__main__":
