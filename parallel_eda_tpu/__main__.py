@@ -199,6 +199,11 @@ def main(argv=None) -> int:
         # argparse surface — job queue, AOT program library, tenants
         from .serve.cli import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "daemon":
+        # long-lived daemon subcommand (serve/daemon_cli.py): durable
+        # inbox, admission control, shedding, crash-restart recovery
+        from .serve.daemon_cli import main as daemon_main
+        return daemon_main(argv[1:])
     for i, a in enumerate(argv):
         try:
             if a == "--settings_file":

@@ -24,6 +24,7 @@ from .faults import (
     FaultPlan,
 )
 from .checkpoint import CheckpointStore
+from .journal import Heartbeat, JournalStore
 from .watchdog import DispatchGuard, DispatchPoisonedError, Rung
 from .ladder import DegradationLadder
 
@@ -79,6 +80,8 @@ __all__ = [
     "BackendLostError",
     "FaultPlan",
     "CheckpointStore",
+    "Heartbeat",
+    "JournalStore",
     "DispatchGuard",
     "DispatchPoisonedError",
     "Rung",

@@ -53,6 +53,38 @@ class CheckpointStore:
         self.dir = directory
         self.plan = plan        # optional FaultPlan ("checkpoint.corrupt")
         os.makedirs(directory, exist_ok=True)
+        self.gc()
+
+    def gc(self) -> int:
+        """Bound the store to its two-generation contract on startup.
+
+        A crash between the tmp write and the rename leaves an orphaned
+        ``*.ck.tmp`` blob; a crash *loop* over changing job ids leaks
+        them without bound.  Only files this store itself creates are
+        touched (``<id>.ck.tmp``), and only at init — save() is about
+        to overwrite its own tmp anyway, so a single-process store can
+        never GC a live write.  Returns the number of blobs removed."""
+        removed = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.endswith(".ck.tmp"):
+                continue
+            try:
+                os.remove(os.path.join(self.dir, name))
+                removed += 1
+            except OSError:
+                continue
+        if removed:
+            get_metrics().counter(
+                "route.resil.checkpoint_gc").inc(removed)
+            tr = get_tracer()
+            if tr is not None:
+                tr.instant("route.resil.checkpoint.gc", cat="resil",
+                           removed=removed)
+        return removed
 
     def _path(self, job_id: str) -> str:
         safe = "".join(c if (c.isalnum() or c in "-_.") else "_"

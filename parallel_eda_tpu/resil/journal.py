@@ -1,0 +1,172 @@
+"""Daemon job-state journal + liveness heartbeat file.
+
+The route daemon (serve/daemon.py) survives its own death by writing
+two small durable artifacts next to its inbox:
+
+* **journal** — one JSON document of every known job's admission state
+  (accepted/in-flight/terminal, with rejection reasons and shed
+  causes).  Written atomically — tmp + fsync + rename, the same dance
+  as ``resil/checkpoint.py`` — with the previous good generation kept
+  as ``.prev`` fallback.  A restarted daemon re-admits every
+  ``in_flight`` entry idempotently (dedupe on job_id) and resumes it
+  from its durable route checkpoint, so a SIGKILL between windows
+  changes timing only, never QoR.
+* **heartbeat** — a tiny liveness file rewritten (atomically) every
+  ``interval_s``; its wall-clock age is how an external watcher (or
+  ``tools/route_daemon.py status``) distinguishes "busy" from "dead".
+  The daemon also tracks its own worst inter-beat gap, which
+  ``flow_doctor --daemon-summary`` gates: a daemon that stops beating
+  while claiming to be alive is unhealthy.
+
+Both stores are deliberately dependency-light (stdlib + obs.metrics):
+they must stay writable while the routing layer is on fire.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+from ..obs.metrics import get_metrics
+from ..obs.trace import get_tracer
+
+JOURNAL_SCHEMA = 1
+
+
+def _atomic_write_json(path: str, doc: dict, rotate: bool = False) -> None:
+    """tmp + fsync + rename (checkpoint.py conventions); with
+    ``rotate`` the current generation is kept as ``path + ".prev"`` so
+    a torn write can never cost more than one update."""
+    blob = json.dumps(doc, sort_keys=True, default=str).encode("utf-8")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    if rotate and os.path.exists(path):
+        os.replace(path, path + ".prev")
+    os.replace(tmp, path)
+
+
+class JournalStore:
+    """Atomic two-generation journal of daemon job states.
+
+    The journal is one document, not an append log: the daemon's whole
+    job table is small (bounded by the admission controller), and a
+    single atomic rewrite per cycle means recovery never has to replay
+    anything — load() is the complete truth as of the last flush."""
+
+    NAME = "journal.json"
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, self.NAME)
+        self.writes = 0
+
+    def save(self, jobs: dict, extra: Optional[dict] = None) -> str:
+        """Flush the full job table (job_id -> state dict) plus any
+        daemon bookkeeping (``extra``, e.g. the consumed inbox
+        offset)."""
+        doc = {"schema": JOURNAL_SCHEMA, "ts": time.time(),
+               "jobs": jobs}
+        if extra:
+            doc.update(extra)
+        _atomic_write_json(self.path, doc, rotate=True)
+        self.writes += 1
+        get_metrics().counter("route.resil.journal_writes").inc()
+        return self.path
+
+    def load(self) -> Optional[dict]:
+        """Newest verifiable journal document, or None (fresh start).
+        A generation that fails to parse falls back to ``.prev`` with
+        a counted fallback, mirroring CheckpointStore.load()."""
+        m = get_metrics()
+        for cand in (self.path, self.path + ".prev"):
+            try:
+                with open(cand, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                continue
+            try:
+                doc = json.loads(blob.decode("utf-8"))
+                if not isinstance(doc, dict) \
+                        or not isinstance(doc.get("jobs"), dict):
+                    raise ValueError("journal has no job table")
+                if int(doc.get("schema", 0)) > JOURNAL_SCHEMA:
+                    raise ValueError("journal schema newer than reader")
+            except (ValueError, UnicodeDecodeError) as e:
+                m.counter("route.resil.journal_fallbacks").inc()
+                tr = get_tracer()
+                if tr is not None:
+                    tr.instant("route.resil.journal.fallback",
+                               cat="resil", file=cand, error=str(e))
+                continue
+            m.counter("route.resil.journal_recoveries").inc()
+            return doc
+        return None
+
+
+class Heartbeat:
+    """Liveness heartbeat file + worst-gap tracker.
+
+    ``beat()`` is called once per daemon cycle; it rewrites the file
+    (atomically) only when ``interval_s`` has elapsed, and records the
+    worst observed inter-beat gap — the number the doctor's
+    heartbeat-gap rule checks against ``interval_s``."""
+
+    def __init__(self, path: str, interval_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._wall = wall
+        self._t0 = clock()
+        self._last: Optional[float] = None
+        self.beats = 0
+        self.max_gap_s = 0.0
+
+    def beat(self, **state) -> bool:
+        """Write the heartbeat if due.  Extra ``state`` (queue depth,
+        cycle counter) rides along for ``status`` readers."""
+        now = self._clock()
+        if self._last is not None:
+            gap = now - self._last
+            if gap < self.interval_s:
+                return False
+            self.max_gap_s = max(self.max_gap_s, gap)
+            get_metrics().gauge("route.daemon.heartbeat_age_s").set(
+                round(gap, 3))
+        self._last = now
+        self.beats += 1
+        get_metrics().counter("route.daemon.heartbeats").inc()
+        _atomic_write_json(self.path, {
+            "ts": self._wall(), "pid": os.getpid(),
+            "uptime_s": round(now - self._t0, 3),
+            "interval_s": self.interval_s, **state})
+        return True
+
+    def summary(self) -> dict:
+        return {"file": self.path, "interval_s": self.interval_s,
+                "beats": self.beats,
+                "max_gap_s": round(self.max_gap_s, 3)}
+
+    @staticmethod
+    def read(path: str, wall: Callable[[], float] = time.time) -> dict:
+        """Read a heartbeat file from outside the daemon; returns the
+        document plus its wall-clock ``age_s`` (inf when missing or
+        unreadable — absent liveness is indistinguishable from dead)."""
+        try:
+            with open(path, "rb") as f:
+                doc = json.loads(f.read().decode("utf-8"))
+            if not isinstance(doc, dict):
+                raise ValueError("not an object")
+        except (OSError, ValueError, UnicodeDecodeError) as e:
+            return {"age_s": float("inf"), "error": str(e)}
+        ts = doc.get("ts")
+        doc["age_s"] = (wall() - ts if isinstance(ts, (int, float))
+                        else float("inf"))
+        return doc

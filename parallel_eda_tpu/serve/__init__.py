@@ -13,10 +13,14 @@ from .library import ProgramLibrary
 from .queue import JobQueue, RouteJob, JobState
 from .batcher import CrossJobPlan, RungPlan, pack_jobs
 from .service import RouteService, ServeJobSpec
+from .daemon import (AdmissionController, DaemonOpts, InboxReader,
+                     RouteDaemon, build_daemon, submit_job)
 
 __all__ = [
     "ProgramLibrary",
     "JobQueue", "RouteJob", "JobState",
     "CrossJobPlan", "RungPlan", "pack_jobs",
     "RouteService", "ServeJobSpec",
+    "AdmissionController", "DaemonOpts", "InboxReader",
+    "RouteDaemon", "build_daemon", "submit_job",
 ]

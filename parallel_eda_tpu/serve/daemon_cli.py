@@ -1,0 +1,189 @@
+"""`python -m parallel_eda_tpu daemon` / tools/route_daemon.py.
+
+Three subcommands around one durable inbox directory:
+
+    # start the long-lived daemon (runs until drained/idle/signaled)
+    python -m parallel_eda_tpu daemon run --inbox box/ --luts 10 \
+        --exit_when_idle 5 --summary box/summary.json
+
+    # submit work from any process (atomic spec + O_APPEND line)
+    python -m parallel_eda_tpu daemon submit --inbox box/ --luts 10 \
+        --seed 3 --tenant acme --priority 2
+
+    # liveness + journal peek from outside (no daemon import of state)
+    python -m parallel_eda_tpu daemon status --inbox box/
+
+`run` prints (and with --summary atomically writes) the summary JSON
+that ``tools/flow_doctor.py --daemon-summary`` gates.  A SIGTERM/SIGINT
+stops the loop at the next cycle boundary with the journal flushed; a
+SIGKILL is the crash the journal + durable checkpoints exist for —
+restart with the same --inbox and every in-flight job resumes to a
+bit-identical answer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="parallel_eda_tpu daemon",
+        description="long-lived route daemon: durable inbox, admission "
+                    "control, overload shedding, crash-restart recovery")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="start the daemon loop")
+    r.add_argument("--inbox", required=True,
+                   help="durable inbox directory (submit.jsonl, specs/, "
+                   "journal/, ckpt/, heartbeat.json live here)")
+    r.add_argument("--luts", type=int, default=10,
+                   help="device graph size this daemon serves (all "
+                   "jobs must match)")
+    r.add_argument("--chan_width", type=int, default=16)
+    r.add_argument("--batch_size", type=int, default=32)
+    r.add_argument("--max_router_iterations", type=int, default=50)
+    r.add_argument("--slice", type=int, default=2, dest="slice_iters",
+                   help="router iterations per queue slice (preemption "
+                   "grain; also the durable-checkpoint cadence)")
+    r.add_argument("--library", default="",
+                   help="AOT program library directory (warms the "
+                   "admission capacity estimate)")
+    r.add_argument("--compile_cache_dir", default="")
+    r.add_argument("--runs_dir", default="",
+                   help="observatory corpus (also feeds admission "
+                   "capacity from recent per-tenant nets/s)")
+    r.add_argument("--scenario", default="")
+    r.add_argument("--sync", action="store_true")
+    r.add_argument("--poll_s", type=float, default=0.2)
+    r.add_argument("--heartbeat_s", type=float, default=1.0)
+    r.add_argument("--slices_per_cycle", type=int, default=4)
+    r.add_argument("--admit_horizon_s", type=float, default=600.0)
+    r.add_argument("--overload_factor", type=float, default=2.0)
+    r.add_argument("--max_queue_depth", type=int, default=64)
+    r.add_argument("--aging_rate", type=float, default=0.05,
+                   help="queue priority points per waiting second "
+                   "(0 = strict priority, starvation possible)")
+    r.add_argument("--exit_when_idle", type=int, default=0,
+                   help="exit after this many consecutive idle cycles "
+                   "(0 = run forever)")
+    r.add_argument("--max_cycles", type=int, default=0,
+                   help="hard cycle cap (0 = none; tests/smoke)")
+    r.add_argument("--summary", default="",
+                   help="also write the summary JSON here (atomic)")
+
+    s = sub.add_parser("submit", help="submit one synthetic job")
+    s.add_argument("--inbox", required=True)
+    s.add_argument("--luts", type=int, default=10)
+    s.add_argument("--chan_width", type=int, default=16)
+    s.add_argument("--seed", type=int, default=1)
+    s.add_argument("--name", default="")
+    s.add_argument("--tenant", default="default")
+    s.add_argument("--priority", type=int, default=0)
+    s.add_argument("--deadline_s", type=float, default=0.0)
+    s.add_argument("--max_iterations", type=int, default=0)
+    s.add_argument("--job_id", default="")
+
+    t = sub.add_parser("status", help="heartbeat + journal peek")
+    t.add_argument("--inbox", required=True)
+    t.add_argument("--stale_s", type=float, default=10.0,
+                   help="exit 1 when the heartbeat is older than this")
+    return p
+
+
+def _cmd_run(args) -> int:
+    from ..obs.metrics import get_metrics
+    from .daemon import DaemonOpts, build_daemon
+    from .queue import JobState
+
+    t_start = time.perf_counter()
+    get_metrics().enabled = True
+    opts = DaemonOpts(
+        poll_s=args.poll_s, heartbeat_s=args.heartbeat_s,
+        slices_per_cycle=args.slices_per_cycle,
+        admit_horizon_s=args.admit_horizon_s,
+        overload_factor=args.overload_factor,
+        max_queue_depth=args.max_queue_depth,
+        aging_rate=args.aging_rate,
+        exit_when_idle=args.exit_when_idle)
+    daemon = build_daemon(
+        args.inbox, luts=args.luts, chan_width=args.chan_width,
+        batch_size=args.batch_size,
+        max_router_iterations=args.max_router_iterations,
+        slice_iters=args.slice_iters,
+        library_dir=args.library or None,
+        compile_cache_dir=args.compile_cache_dir or None,
+        runs_dir=args.runs_dir or None,
+        scenario=args.scenario or None,
+        opts=opts, sync=args.sync)
+
+    def _graceful(signum, frame):
+        daemon.request_stop()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    jobs = daemon.run(max_cycles=args.max_cycles)
+    summary = daemon.summary()
+    summary["wall_s"] = round(time.perf_counter() - t_start, 3)
+    blob = json.dumps(summary, default=str)
+    if args.summary:
+        tmp = args.summary + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, args.summary)
+    print(blob)
+    bad = [j for j in jobs
+           if j.state in (JobState.FAILED, JobState.TIMEOUT)]
+    return 1 if bad else 0
+
+
+def _cmd_submit(args) -> int:
+    from .daemon import submit_job
+    spec = {"luts": args.luts, "chan_width": args.chan_width,
+            "seed": args.seed,
+            "name": args.name or f"l{args.luts}_s{args.seed}"}
+    if args.max_iterations:
+        spec["max_iterations"] = args.max_iterations
+    job_id = submit_job(
+        args.inbox, spec, tenant=args.tenant, priority=args.priority,
+        deadline_s=args.deadline_s or None,
+        job_id=args.job_id or f"{args.tenant}-{spec['name']}")
+    print(json.dumps({"job_id": job_id, "inbox": args.inbox}))
+    return 0
+
+
+def _cmd_status(args) -> int:
+    from ..resil.journal import Heartbeat, JournalStore
+    from .daemon import HEARTBEAT_NAME
+    hb = Heartbeat.read(os.path.join(args.inbox, HEARTBEAT_NAME))
+    doc = JournalStore(os.path.join(args.inbox, "journal")).load()
+    states = {}
+    for e in (doc or {}).get("jobs", {}).values():
+        s = e.get("state", "?")
+        states[s] = states.get(s, 0) + 1
+    out = {"heartbeat": hb, "journal_jobs": states,
+           "alive": hb.get("age_s", float("inf")) <= args.stale_s}
+    print(json.dumps(out, default=str))
+    return 0 if out["alive"] else 1
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(
+        sys.argv[1:] if argv is None else argv)
+    if args.cmd == "run":
+        return _cmd_run(args)
+    if args.cmd == "submit":
+        return _cmd_submit(args)
+    return _cmd_status(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

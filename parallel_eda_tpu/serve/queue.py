@@ -19,6 +19,16 @@ domain: it receives a ``RouteJob`` and returns one of
 A raised exception counts as a failed attempt.  service.py provides
 the Router-backed runner; tests drive the queue with fakes.
 
+Scheduling order is *aged* priority: a job's effective priority grows
+with its wait time (``aging_rate`` points per queued second), so a
+continuous stream of high-priority arrivals can delay a low-priority
+job but never starve it forever.  Because every queued job ages at the
+same rate, the relative order of any two jobs is time-invariant —
+``p + r*(now - t_admit)`` comparisons cancel the ``now`` — which lets
+the heap key stay static: ``r*t_admit - p``.  ``aging_rate=0``
+(default) is exact strict-priority, bit-compatible with the pre-aging
+queue.
+
 Stdlib + obs.metrics only.
 """
 
@@ -39,6 +49,7 @@ class JobState(Enum):
     DONE = "done"
     FAILED = "failed"
     TIMEOUT = "timeout"
+    SHED = "shed"          # evicted under overload (daemon load shedding)
 
 
 @dataclass
@@ -74,6 +85,8 @@ class RouteJob:
         if self.state in (JobState.FAILED, JobState.TIMEOUT):
             return (f"{self.state.value}: {self.error} "
                     f"(attempts={self.attempts})")
+        if self.state is JobState.SHED:
+            return f"shed: {self.error}"
         return None
 
 
@@ -85,38 +98,91 @@ class JobQueue:
     """Priority heap + cooperative run loop."""
 
     def __init__(self, clock: Callable[[], float] = time.monotonic,
-                 sleep: Callable[[float], None] = time.sleep):
-        self._heap: List[Tuple[int, int, RouteJob]] = []
+                 sleep: Callable[[float], None] = time.sleep,
+                 aging_rate: float = 0.0):
+        self._heap: List[Tuple[float, int, RouteJob]] = []
         self._seq = 0
         self._clock = clock
         self._sleep = sleep
+        # priority points gained per queued second (see module doc);
+        # 0 = strict priority.  Mutable: the daemon sets it before any
+        # admit, but a mid-stream change only affects later pushes.
+        self.aging_rate = float(aging_rate)
         self.jobs: List[RouteJob] = []
+        self._by_id: Dict[str, RouteJob] = {}
 
     # ------------------------------------------------------ admit
 
     def admit(self, job: RouteJob) -> RouteJob:
-        if not job.job_id:
+        """Admit a job; idempotent on job_id.  Re-submitting an id the
+        queue already knows (the restart/replay path: a recovered
+        journal entry racing the re-read inbox) returns the EXISTING
+        job unchanged — never a duplicate heap entry, never a state
+        reset on a job that already ran."""
+        if job.job_id:
+            existing = self._by_id.get(job.job_id)
+            if existing is not None:
+                get_metrics().counter("route.serve.jobs_deduped").inc()
+                return existing
+        else:
             job.job_id = f"job{len(self.jobs):04d}"
         job.admitted_t = self._clock()
         job.state = JobState.QUEUED
         self.jobs.append(job)
+        self._by_id[job.job_id] = job
         self._push(job)
         get_metrics().counter("route.serve.jobs_admitted").inc()
         self._depth_gauge()
         return job
 
+    def get(self, job_id: str) -> Optional[RouteJob]:
+        return self._by_id.get(job_id)
+
+    def effective_priority(self, job: RouteJob,
+                           now: Optional[float] = None) -> float:
+        """Aged priority at ``now``: the number the heap order (and the
+        daemon's shed-victim ranking) is actually based on."""
+        now = self._clock() if now is None else now
+        return job.priority + self.aging_rate * (now - job.admitted_t)
+
     def _push(self, job: RouteJob) -> None:
         # fresh seq on every (re)queue: equal-priority jobs round-robin
-        # between slices instead of one job monopolizing the device
+        # between slices instead of one job monopolizing the device.
+        # The key is the time-invariant aged-priority order (module
+        # doc): aging_rate * admitted_t - priority, ascending.
         self._seq += 1
-        heapq.heappush(self._heap, (-job.priority, self._seq, job))
+        key = self.aging_rate * job.admitted_t - job.priority
+        heapq.heappush(self._heap, (key, self._seq, job))
 
     def _depth_gauge(self) -> None:
-        get_metrics().gauge("route.serve.queue_depth").set(
-            len(self._heap))
+        get_metrics().gauge("route.serve.queue_depth").set(self.depth())
 
     def depth(self) -> int:
-        return len(self._heap)
+        """Queued (runnable) jobs; shed tombstones don't count."""
+        return sum(1 for _, _, j in self._heap
+                   if j.state is JobState.QUEUED)
+
+    def queued_jobs(self) -> List[RouteJob]:
+        """Jobs currently waiting in the heap (admission order not
+        guaranteed) — the shed-victim candidate set."""
+        return [j for _, _, j in self._heap
+                if j.state is JobState.QUEUED]
+
+    # ------------------------------------------------------- evict
+
+    def evict(self, job_id: str, state: JobState = JobState.SHED,
+              error: Optional[str] = None) -> Optional[RouteJob]:
+        """Remove a QUEUED job from scheduling (overload shedding).
+        The heap entry becomes a tombstone the run loop skips; jobs
+        already terminal or mid-slice are left alone (returns None)."""
+        job = self._by_id.get(job_id)
+        if job is None or job.state is not JobState.QUEUED:
+            return None
+        job.state = state
+        job.error = error
+        get_metrics().counter("route.serve.jobs_shed").inc()
+        self._depth_gauge()
+        return job
 
     # -------------------------------------------------------- run
 
@@ -127,8 +193,10 @@ class JobQueue:
         m = get_metrics()
         slices = 0
         while self._heap and slices < max_slices:
-            slices += 1
             _, _, job = heapq.heappop(self._heap)
+            if job.state is not JobState.QUEUED:
+                continue               # shed tombstone; costs no slice
+            slices += 1
             self._depth_gauge()
             now = self._clock()
             if job.deadline_exceeded(now):
@@ -141,7 +209,8 @@ class JobQueue:
                 # backoff not elapsed; if it's the only job, wait it out
                 self._push(job)
                 if all(self._clock() < j.not_before
-                       for _, _, j in self._heap):
+                       for _, _, j in self._heap
+                       if j.state is JobState.QUEUED):
                     self._sleep(max(0.0, job.not_before - self._clock()))
                 continue
             job.state = JobState.RUNNING

@@ -73,14 +73,27 @@ class RouteService:
         self.scenario = scenario
         self.cfg = dict(cfg or {})
         self.queue = JobQueue()
+        self.draining = False
         self._t_init = time.perf_counter()
         self._first_slice_s: Optional[float] = None
 
     # ------------------------------------------------------- admit
 
+    def begin_drain(self) -> None:
+        """Drain hook (the daemon's shutdown path): stop taking new
+        work, let everything already queued finish.  admit() refuses
+        with a counted error from here on; run() is unaffected."""
+        self.draining = True
+        get_metrics().gauge("route.serve.draining").set(1)
+
     def admit(self, spec: ServeJobSpec, tenant: str = "default",
               priority: int = 0, deadline_s: Optional[float] = None,
               max_retries: int = 0, job_id: str = "") -> RouteJob:
+        if self.draining:
+            get_metrics().counter("route.serve.drain_refusals").inc()
+            raise RuntimeError(
+                f"service is draining: refusing job "
+                f"{spec.name or job_id or '?'} (drain hook active)")
         R, _ = spec.term.sinks.shape
         if R and int(spec.term.source.max()) >= self.rr.num_nodes:
             raise ValueError(
@@ -91,7 +104,10 @@ class RouteService:
         job = RouteJob(tenant=tenant, payload=spec, job_id=job_id,
                        priority=priority, deadline_s=deadline_s,
                        max_retries=max_retries)
-        self.queue.admit(job)
+        # queue.admit is idempotent on job_id: a replayed submission
+        # returns the EXISTING job (restart/recovery path), so pass
+        # that back rather than the discarded duplicate
+        job = self.queue.admit(job)
         self._publish_pack_plan()
         return job
 

@@ -1,0 +1,646 @@
+"""Long-lived route daemon (parallel_eda_tpu/serve/daemon.py).
+
+Three layers:
+
+* units — InboxReader torn-line tolerance, submit_job durability
+  layout, the AdmissionController's machine-readable verdicts (fake
+  clocks, no jax);
+* daemon loop — admit / shed / journal / heartbeat / recovery against
+  a fake service (real JobQueue, fake runner, fake clocks), plus the
+  flow_doctor --daemon-summary rule set over crafted summaries;
+* crash parity — a REAL daemon subprocess SIGKILLed mid-flight, then
+  restarted on the same inbox: every job finishes DONE with
+  wirelengths bit-identical to an uninterrupted reference daemon, and
+  the doctor calls the summary HEALTHY.
+
+    python -m pytest tests/ -m daemon
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from parallel_eda_tpu.obs import MetricsRegistry, get_metrics, set_metrics
+from parallel_eda_tpu.resil.journal import Heartbeat, JournalStore
+from parallel_eda_tpu.serve.daemon import (SUBMIT_NAME, AdmissionController,
+                                           DaemonOpts, InboxReader,
+                                           RouteDaemon, submit_job)
+from parallel_eda_tpu.serve.queue import JobQueue, JobState, RouteJob
+
+pytestmark = pytest.mark.daemon
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLOW_DOCTOR = os.path.join(REPO, "tools", "flow_doctor.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    set_metrics(MetricsRegistry())
+    yield
+    set_metrics(MetricsRegistry())
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---- inbox protocol (no jax) ---------------------------------------
+
+def test_submit_then_poll_roundtrip(tmp_path):
+    box = str(tmp_path)
+    jid = submit_job(box, {"luts": 4, "seed": 1, "name": "a"},
+                     tenant="t0", priority=3)
+    r = InboxReader(os.path.join(box, SUBMIT_NAME))
+    subs = r.poll()
+    assert [s["job_id"] for s in subs] == [jid]
+    assert subs[0]["tenant"] == "t0" and subs[0]["priority"] == 3
+    # the spec file the line points at was installed atomically first
+    spec = json.load(open(os.path.join(box, subs[0]["spec"])))
+    assert spec["seed"] == 1
+    assert r.poll() == []          # nothing new
+
+
+def test_inbox_invalid_line_skipped_counted(tmp_path):
+    path = os.path.join(str(tmp_path), SUBMIT_NAME)
+    with open(path, "wb") as f:
+        f.write(b'\x80\xfe{"torn": tr\n')
+        f.write(b'{"job_id": "ok", "spec": "s.json"}\n')
+    r = InboxReader(path)
+    subs = r.poll()
+    assert [s["job_id"] for s in subs] == ["ok"]
+    assert r.torn == 1
+    assert get_metrics().counter(
+        "route.daemon.inbox_torn_lines").value == 1
+
+
+def test_inbox_torn_tail_grace_then_skip(tmp_path):
+    path = os.path.join(str(tmp_path), SUBMIT_NAME)
+    with open(path, "wb") as f:
+        f.write(b'{"job_id": "a", "spec": "s.json"}\n')
+        f.write(b'{"job_id": "half')       # submitter mid-write
+    r = InboxReader(path, grace=2)
+    assert [s["job_id"] for s in r.poll()] == ["a"]
+    assert r.torn == 0                      # tail still in grace
+    # the submitter finishes the line before grace expires: consumed
+    with open(path, "ab") as f:
+        f.write(b'_done", "spec": "s.json"}\n')
+    assert [s["job_id"] for s in r.poll()] == ["half_done"]
+    # now a tail that never completes: skipped after `grace` polls
+    # observe it unchanged
+    with open(path, "ab") as f:
+        f.write(b'{"job_id": "aband')
+    assert r.poll() == []                   # tail noticed
+    assert r.poll() == [] and r.torn == 0   # grace poll 1
+    assert r.poll() == []                   # grace reached: abandoned
+    assert r.torn == 1
+    # later appends after the abandoned tail still parse
+    with open(path, "ab") as f:
+        f.write(b'oned"}\n')               # completes into garbage...
+    r2 = r.poll()                           # ...which is its own line
+    assert r2 == [] or all("job_id" in s for s in r2)
+
+
+def test_inbox_truncation_resets_offset(tmp_path):
+    path = os.path.join(str(tmp_path), SUBMIT_NAME)
+    with open(path, "wb") as f:
+        f.write(b'{"job_id": "a", "spec": "s.json"}\n')
+    r = InboxReader(path)
+    assert len(r.poll()) == 1
+    # rotation is detected by shrinkage (size < consumed offset)
+    with open(path, "wb") as f:             # rotated underneath us
+        f.write(b'{"job_id": "b"}\n')
+    assert [s["job_id"] for s in r.poll()] == ["b"]
+
+
+# ---- admission controller (no jax) ---------------------------------
+
+def _decide(ac, **kw):
+    base = dict(nets=10, tenant="t0", deadline_s=None, backlog_nets=0,
+                queue_depth=0, tenant_depth=0)
+    base.update(kw)
+    return ac.decide(**base)
+
+
+def test_admission_rejects_are_machine_readable():
+    opts = DaemonOpts(max_queue_depth=4, admit_horizon_s=100.0,
+                      default_nets_per_s=10.0, cold_start_factor=1.0)
+    ac = AdmissionController(opts)
+    assert _decide(ac) is None
+    full = _decide(ac, queue_depth=4)
+    assert full["code"] == "queue_full" and "detail" in full
+    hog = _decide(ac, queue_depth=3, tenant_depth=3)
+    assert hog["code"] == "tenant_over_fair_share"
+    slow = _decide(ac, nets=2000)
+    assert slow["code"] == "over_capacity"
+    assert slow["est_s"] > slow["horizon_s"]
+    late = _decide(ac, nets=50, deadline_s=1.0)
+    assert late["code"] == "over_capacity"
+    assert late["deadline_s"] == 1.0
+    drained = _decide(ac, draining=True)
+    assert drained["code"] == "draining"
+
+
+def test_admission_cold_start_discount():
+    opts = DaemonOpts(default_nets_per_s=10.0, cold_start_factor=0.25)
+    cold = AdmissionController(opts, library_warm=False)
+    warm = AdmissionController(opts, library_warm=True)
+    assert cold.capacity_nets_per_s() == pytest.approx(2.5)
+    assert warm.capacity_nets_per_s() == pytest.approx(10.0)
+
+
+def test_admission_capacity_from_corpus(tmp_path):
+    from parallel_eda_tpu.obs.runstore import append_run, make_record
+    runs = str(tmp_path / "runs")
+    for v, ten in ((4.0, "t0"), (8.0, "t0"), (6.0, "t0"), (99.0, "tz")):
+        append_run(runs, make_record(
+            scenario="dmn", cfg={"j": ten}, metric="nets_per_s",
+            value=v, unit="nets/s", backend="cpu", device_kind="cpu",
+            tenant=ten, job_id=f"{ten}-{v}"))
+    ac = AdmissionController(DaemonOpts(), runs_dir=runs,
+                             scenario="dmn")
+    # median of t0's own trajectory, not the cold-start prior and not
+    # the other tenant's outlier
+    assert ac.capacity_nets_per_s("t0") == pytest.approx(6.0)
+    # a tenant with no history falls back to the all-tenant rows
+    assert ac.capacity_nets_per_s("new") == pytest.approx(7.0)
+
+
+# ---- daemon loop against a fake service ----------------------------
+
+class _FakeFlow:
+    def __init__(self, nets):
+        self.term = types.SimpleNamespace(source=list(range(nets)))
+
+
+class _FakeService:
+    """RouteService's daemon-facing surface: real JobQueue, fake
+    runner, no jax."""
+
+    def __init__(self, clock, runner=None):
+        self.queue = JobQueue(clock=clock, sleep=lambda s: None)
+        self.draining = False
+        self.runs_dir = None
+        self.scenario = "fake"
+        self.router = types.SimpleNamespace(_library=None)
+        self.runner = runner or (
+            lambda job: ("done", {"wirelength": 7, "iterations": 2,
+                                  "nets": len(job.payload.term.source)}))
+
+    def begin_drain(self):
+        self.draining = True
+
+    def admit(self, spec, tenant="default", priority=0,
+              deadline_s=None, max_retries=0, job_id=""):
+        if self.draining:
+            raise RuntimeError("service is draining")
+        job = RouteJob(tenant=tenant, payload=spec, job_id=job_id,
+                       priority=priority, deadline_s=deadline_s,
+                       max_retries=max_retries)
+        return self.queue.admit(job)
+
+    def _runner(self, job):
+        return self.runner(job)
+
+
+def _mk_daemon(tmp_path, clock=None, opts=None, runner=None, svc=None):
+    clock = clock or _Clock()
+    svc = svc or _FakeService(clock, runner=runner)
+    d = RouteDaemon(
+        svc, str(tmp_path / "box"),
+        opts or DaemonOpts(default_nets_per_s=10.0,
+                           cold_start_factor=1.0, exit_when_idle=1),
+        flow_builder=lambda spec: _FakeFlow(int(spec.get("nets", 10))),
+        clock=clock, wall=lambda: 1000.0 + clock.t,
+        sleep=lambda s: setattr(clock, "t", clock.t + s))
+    return d, svc, clock
+
+
+def test_daemon_admits_runs_and_journals(tmp_path):
+    d, svc, clock = _mk_daemon(tmp_path)
+    box = d.inbox_dir
+    submit_job(box, {"nets": 5, "name": "a"}, tenant="t0",
+               job_id="a", ts=999.5)
+    submit_job(box, {"nets": 5, "name": "b"}, tenant="t1",
+               job_id="b", ts=999.9)
+    jobs = d.run()
+    assert sorted(j.job_id for j in jobs) == ["a", "b"]
+    assert all(j.state is JobState.DONE for j in jobs)
+    v = get_metrics().values("route.daemon.")
+    assert v["route.daemon.admitted"] == 2
+    # gauge holds the LAST consumed line's lag (b: wall 1000 - 999.9)
+    assert v["route.daemon.inbox_lag_s"] == pytest.approx(0.1)
+    # the journal's final generation records both jobs as done
+    doc = d.journal.load()
+    assert set(doc["jobs"]) == {"a", "b"}
+    assert all(e["state"] == "done" for e in doc["jobs"].values())
+    assert doc["inbox_offset"] == d.reader.offset > 0
+    s = d.summary()
+    assert {j["job_id"]: j["state"] for j in s["jobs"]} == \
+        {"a": "done", "b": "done"}
+
+
+def test_daemon_rejects_with_reason_and_rejected_jsonl(tmp_path):
+    opts = DaemonOpts(admit_horizon_s=5.0, default_nets_per_s=10.0,
+                      cold_start_factor=1.0, exit_when_idle=1)
+    d, svc, clock = _mk_daemon(tmp_path, opts=opts)
+    submit_job(d.inbox_dir, {"nets": 1000, "name": "big"},
+               job_id="big")
+    d.run()
+    assert get_metrics().counter("route.daemon.rejected").value == 1
+    assert d.rejected["big"]["reason"]["code"] == "over_capacity"
+    lines = [json.loads(ln) for ln in
+             open(os.path.join(d.inbox_dir, "rejected.jsonl"))]
+    assert lines[0]["job_id"] == "big"
+    assert lines[0]["reason"]["code"] == "over_capacity"
+    # the rejection is remembered: replaying the line is a no-op
+    row = [j for j in d.summary()["jobs"] if j["job_id"] == "big"][0]
+    assert row["state"] == "rejected"
+    assert row["reject_reason"]["code"] == "over_capacity"
+
+
+def test_daemon_bad_spec_rejected_not_crash(tmp_path):
+    d, svc, clock = _mk_daemon(tmp_path)
+    # submission pointing at a spec file that was never installed
+    line = {"job_id": "ghost", "tenant": "t", "spec": "specs/none.json"}
+    with open(os.path.join(d.inbox_dir, SUBMIT_NAME), "ab") as f:
+        f.write((json.dumps(line) + "\n").encode())
+    d.run()
+    assert d.rejected["ghost"]["reason"]["code"] == "bad_spec"
+
+
+def test_daemon_overload_shed_with_cause(tmp_path):
+    opts = DaemonOpts(admit_horizon_s=10.0, overload_factor=1.0,
+                      default_nets_per_s=10.0, cold_start_factor=1.0,
+                      exit_when_idle=1)
+    clock = _Clock()
+    svc = _FakeService(clock)
+    d, svc, clock = _mk_daemon(tmp_path, clock=clock, opts=opts,
+                               svc=svc)
+    # bypass admission (each alone is admissible; together they
+    # overload): 4 jobs x 60 nets at 10 nets/s = 24s backlog > 10s
+    for i in range(4):
+        svc.admit(_FakeFlow(60), tenant=f"t{i}", priority=i,
+                  job_id=f"j{i}")
+        clock.t += 1.0
+    shed = d._shed_overload()
+    # sheds until the backlog fits the horizon: 24s -> 18 -> 12 -> 6s,
+    # so exactly three victims go and one survivor remains
+    assert shed == 3
+    assert d._backlog_nets() / 10.0 <= 10.0
+    assert get_metrics().counter("route.daemon.shed").value == shed
+    assert get_metrics().counter(
+        "route.daemon.overloaded_cycles").value == 1
+    for jid, cause in d.shed_causes.items():
+        assert cause["code"] == "overload" and cause["backlog_s"] > 0
+    # lowest aged priority went first (priorities 0..3, same rate):
+    shed_ids = sorted(d.shed_causes)
+    assert shed_ids == [f"j{i}" for i in range(shed)]
+    # rejected.jsonl carries the shed records too
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(d.inbox_dir, "rejected.jsonl"))]
+    assert {r["job_id"] for r in recs} == set(shed_ids)
+    assert all(r["state"] == "shed" for r in recs)
+
+
+def test_daemon_shed_prefers_over_fair_share_tenant(tmp_path):
+    opts = DaemonOpts(admit_horizon_s=1.0, overload_factor=1.0,
+                      default_nets_per_s=10.0, cold_start_factor=1.0,
+                      fair_share_frac=0.5, fair_share_floor=1)
+    clock = _Clock()
+    svc = _FakeService(clock)
+    d, svc, clock = _mk_daemon(tmp_path, clock=clock, opts=opts,
+                               svc=svc)
+    # tenant "hog" holds 3 of 4 slots; all same priority/age
+    for i, ten in enumerate(("hog", "hog", "hog", "meek")):
+        j = svc.admit(_FakeFlow(10), tenant=ten, job_id=f"{ten}{i}")
+        j.payload = _FakeFlow(10)
+    d._shed_overload()
+    # the meek tenant's single job is the LAST standing candidate:
+    # every hog job ranks ahead of it in the victim order
+    if svc.queue.depth() == 1:
+        assert svc.queue.queued_jobs()[0].tenant == "meek"
+    else:
+        assert all(svc.queue.get(f"hog{i}").state is JobState.SHED
+                   for i in range(2))
+
+
+def test_daemon_shed_doomed_deadline_first(tmp_path):
+    opts = DaemonOpts(admit_horizon_s=2.0, overload_factor=1.0,
+                      default_nets_per_s=10.0, cold_start_factor=1.0)
+    clock = _Clock()
+    svc = _FakeService(clock)
+    d, svc, clock = _mk_daemon(tmp_path, clock=clock, opts=opts,
+                               svc=svc)
+    # j_doomed cannot meet its deadline under the backlog; j_ok can.
+    # Despite j_doomed having the higher priority (normally shed
+    # last), it goes first: it is dead either way.
+    svc.admit(_FakeFlow(20), tenant="a", priority=9,
+              deadline_s=1.0, job_id="doomed")
+    svc.admit(_FakeFlow(20), tenant="b", priority=0,
+              deadline_s=999.0, job_id="ok")
+    d._shed_overload()
+    assert svc.queue.get("doomed").state is JobState.SHED
+    assert svc.queue.get("ok").state is JobState.QUEUED
+
+
+def test_daemon_drain_file_rejects_new_work(tmp_path):
+    d, svc, clock = _mk_daemon(tmp_path)
+    submit_job(d.inbox_dir, {"nets": 5}, job_id="early")
+    d.cycle()                       # early admitted and finished
+    assert svc.queue.get("early").state is JobState.DONE
+    open(os.path.join(d.inbox_dir, "DRAIN"), "w").close()
+    submit_job(d.inbox_dir, {"nets": 5}, job_id="late")
+    d.run()
+    # queued work finished; post-drain submissions are rejected with
+    # the draining code and the service-level gauge flipped
+    assert d.rejected["late"]["reason"]["code"] == "draining"
+    assert svc.draining
+    assert svc.queue.get("late") is None
+
+
+def test_daemon_recovery_reads_journal_and_dedupes_inbox(tmp_path):
+    # phase 1: a daemon admits two jobs whose slices always preempt
+    # (in-flight forever), then "dies" (we simply stop calling it)
+    clock1 = _Clock()
+    svc1 = _FakeService(clock1, runner=lambda job: ("preempted",
+                                                    {"it_done": 3}))
+    d1, svc1, clock1 = _mk_daemon(tmp_path, clock=clock1, svc=svc1)
+    submit_job(d1.inbox_dir, {"nets": 5, "name": "a"}, job_id="a")
+    submit_job(d1.inbox_dir, {"nets": 5, "name": "b"}, job_id="b")
+    d1.cycle()
+    doc = d1.journal.load()
+    assert all(e["state"] == "in_flight" for e in doc["jobs"].values())
+
+    # phase 2: the submitter retries both (at-least-once delivery),
+    # then a fresh daemon on the same inbox recovers both from the
+    # journal and DEDUPES the replayed lines instead of duplicating
+    submit_job(d1.inbox_dir, {"nets": 5, "name": "a"}, job_id="a")
+    submit_job(d1.inbox_dir, {"nets": 5, "name": "b"}, job_id="b")
+    clock2 = _Clock()
+    svc2 = _FakeService(clock2)
+    d2, svc2, clock2 = _mk_daemon(tmp_path, clock=clock2, svc=svc2)
+    jobs = d2.run()
+    assert sorted(j.job_id for j in jobs) == ["a", "b"]
+    assert all(j.state is JobState.DONE for j in jobs)
+    assert sorted(d2.recovered_ids) == ["a", "b"]
+    assert get_metrics().counter("route.daemon.recovered").value == 2
+    # no duplicate admissions from the replayed inbox lines
+    assert get_metrics().counter(
+        "route.serve.jobs_deduped").value >= 2
+    rows = {j["job_id"]: j for j in d2.summary()["jobs"]}
+    assert rows["a"]["recovered"] and rows["b"]["recovered"]
+
+
+def test_daemon_recovery_remembers_terminal_rejections(tmp_path):
+    opts = DaemonOpts(admit_horizon_s=5.0, default_nets_per_s=10.0,
+                      cold_start_factor=1.0, exit_when_idle=1)
+    d1, svc1, clock1 = _mk_daemon(tmp_path, opts=opts)
+    submit_job(d1.inbox_dir, {"nets": 1000}, job_id="big")
+    d1.run()
+    assert d1.rejected["big"]["reason"]["code"] == "over_capacity"
+    # the client retries the rejected job; the restarted daemon must
+    # answer from the journal, not re-run admission + re-append
+    submit_job(d1.inbox_dir, {"nets": 1000}, job_id="big")
+    d2, svc2, clock2 = _mk_daemon(tmp_path, opts=opts)
+    d2.run()
+    # the replayed submission of an already-rejected job stays
+    # rejected (no queue entry) without a second rejected.jsonl line
+    assert "big" in d2.rejected
+    assert svc2.queue.get("big") is None
+    lines = open(os.path.join(d2.inbox_dir, "rejected.jsonl")).readlines()
+    assert len(lines) == 1
+
+
+# ---- journal + heartbeat stores (no jax) ---------------------------
+
+def test_journal_roundtrip_and_prev_fallback(tmp_path):
+    js = JournalStore(str(tmp_path))
+    js.save({"a": {"state": "in_flight"}}, extra={"inbox_offset": 10})
+    js.save({"a": {"state": "done"}}, extra={"inbox_offset": 20})
+    doc = js.load()
+    assert doc["jobs"]["a"]["state"] == "done"
+    assert doc["inbox_offset"] == 20
+    # corrupt the current generation: load falls back to .prev
+    with open(js.path, "wb") as f:
+        f.write(b"{torn")
+    doc = js.load()
+    assert doc["jobs"]["a"]["state"] == "in_flight"
+    assert get_metrics().counter(
+        "route.resil.journal_fallbacks").value == 1
+
+
+def test_journal_rejects_newer_schema(tmp_path):
+    js = JournalStore(str(tmp_path))
+    with open(js.path, "w") as f:
+        json.dump({"schema": 999, "jobs": {}}, f)
+    assert js.load() is None
+    assert get_metrics().counter(
+        "route.resil.journal_fallbacks").value == 1
+
+
+def test_heartbeat_interval_and_max_gap(tmp_path):
+    clk = _Clock()
+    hb = Heartbeat(str(tmp_path / "hb.json"), interval_s=1.0,
+                   clock=clk, wall=lambda: 500.0 + clk.t)
+    assert hb.beat(cycle=1)         # first beat always writes
+    clk.t = 0.5
+    assert not hb.beat(cycle=2)     # within the interval: suppressed
+    clk.t = 1.2
+    assert hb.beat(cycle=3)
+    clk.t = 8.0                     # a long stall
+    assert hb.beat(cycle=4)
+    assert hb.beats == 3
+    assert hb.max_gap_s == pytest.approx(6.8)
+    doc = Heartbeat.read(hb.path, wall=lambda: 500.0 + clk.t)
+    assert doc["age_s"] == pytest.approx(0.0)
+    assert doc["cycle"] == 4
+    missing = Heartbeat.read(str(tmp_path / "nope.json"))
+    assert missing["age_s"] == float("inf")
+
+
+# ---- flow_doctor --daemon-summary rules (no jax) -------------------
+
+def _fd():
+    spec = importlib.util.spec_from_file_location("flow_doctor_daemon",
+                                                  FLOW_DOCTOR)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _dsummary(jobs=None, metrics=None, heartbeat=None, journal=None,
+              uptime=30.0):
+    hb = {"file": "hb.json", "interval_s": 1.0, "beats": 20,
+          "max_gap_s": 2.0}
+    hb.update(heartbeat or {})
+    jr = {"file": "journal.json", "writes": 5, "entries": 2}
+    jr.update(journal or {})
+    return {"scenario": "s", "jobs": jobs or [],
+            "daemon": {"uptime_s": uptime, "cycles": 20,
+                       "heartbeat": hb, "journal": jr,
+                       "inbox": {"torn_lines": 0},
+                       "metrics": {f"route.daemon.{k}": v for k, v in
+                                   (metrics or {}).items()}}}
+
+
+def test_doctor_daemon_healthy():
+    errs, notes = _fd().check_daemon(_dsummary(
+        jobs=[{"job_id": "a", "state": "done"},
+              {"job_id": "r", "state": "rejected",
+               "reject_reason": {"code": "queue_full", "detail": "x"}},
+              {"job_id": "s", "state": "shed",
+               "shed_cause": {"code": "overload", "detail": "y"}}],
+        metrics={"overloaded_cycles": 3}))
+    assert errs == []
+    assert notes and "rejected=1" in notes[0]
+
+
+def test_doctor_rejection_without_reason():
+    errs, _ = _fd().check_daemon(_dsummary(
+        jobs=[{"job_id": "r", "state": "rejected"}]))
+    assert any("without a machine-readable reason" in e for e in errs)
+
+
+def test_doctor_shed_without_overload_cause():
+    fd = _fd()
+    # no cause on the job
+    errs, _ = fd.check_daemon(_dsummary(
+        jobs=[{"job_id": "s", "state": "shed"}],
+        metrics={"overloaded_cycles": 1}))
+    assert any("shed without" in e for e in errs)
+    # cause present but the daemon never measured overload
+    errs, _ = fd.check_daemon(_dsummary(
+        jobs=[{"job_id": "s", "state": "shed",
+               "shed_cause": {"code": "overload"}}]))
+    assert any("never recorded an overloaded cycle" in e for e in errs)
+
+
+def test_doctor_heartbeat_gap_and_silence():
+    fd = _fd()
+    errs, _ = fd.check_daemon(_dsummary(
+        heartbeat={"max_gap_s": 30.0}))      # 30 > 10 x 1.0
+    assert any("heartbeat gap" in e for e in errs)
+    errs, _ = fd.check_daemon(_dsummary(heartbeat={"beats": 0}))
+    assert any("zero heartbeats" in e for e in errs)
+
+
+def test_doctor_recovery_without_journal():
+    errs, _ = _fd().check_daemon(_dsummary(
+        jobs=[{"job_id": "a", "state": "done", "recovered": True}],
+        journal={"writes": 0}))
+    assert any("no durable state" in e for e in errs)
+
+
+def test_doctor_cli_daemon_summary_flag(tmp_path):
+    p = str(tmp_path / "summary.json")
+    with open(p, "w") as f:
+        json.dump(_dsummary(), f)
+    r = subprocess.run([sys.executable, FLOW_DOCTOR,
+                        "--daemon-summary", p],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "HEALTHY" in r.stdout
+    with open(p, "w") as f:
+        json.dump(_dsummary(jobs=[{"job_id": "r",
+                                   "state": "rejected"}]), f)
+    r = subprocess.run([sys.executable, FLOW_DOCTOR,
+                        "--daemon-summary", p],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "UNHEALTHY" in r.stderr
+
+
+# ---- kill-and-restart parity (real jax, fresh processes) -----------
+
+_LUTS = 6
+
+
+def _daemon_cmd(box, extra=()):
+    return [sys.executable, os.path.join(REPO, "tools",
+                                         "route_daemon.py"),
+            "run", "--inbox", box, "--luts", str(_LUTS),
+            "--slice", "2", "--heartbeat_s", "2.0",
+            "--exit_when_idle", "2",
+            "--summary", os.path.join(box, "summary.json"), *extra]
+
+
+def _submit(box, seed, job_id):
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "route_daemon.py"),
+         "submit", "--inbox", box, "--luts", str(_LUTS),
+         "--seed", str(seed), "--job_id", job_id],
+        check=True, capture_output=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def _wirelengths(box):
+    doc = json.load(open(os.path.join(box, "summary.json")))
+    return ({j["job_id"]: (j["state"], j.get("wirelength"))
+             for j in doc["jobs"]}, doc)
+
+
+def test_daemon_sigkill_restart_wirelength_parity(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # reference: an uninterrupted daemon over the same two jobs
+    ref_box = str(tmp_path / "ref")
+    os.makedirs(ref_box)
+    _submit(ref_box, 3, "jobA")
+    _submit(ref_box, 4, "jobB")
+    subprocess.run(_daemon_cmd(ref_box), check=True, env=env,
+                   capture_output=True, timeout=420)
+    ref, _ = _wirelengths(ref_box)
+    assert all(state == "done" for state, _ in ref.values())
+
+    # chaos: same jobs, daemon SIGKILLed once a durable checkpoint
+    # exists (mid-flight between windows), then restarted
+    box = str(tmp_path / "box")
+    os.makedirs(box)
+    _submit(box, 3, "jobA")
+    _submit(box, 4, "jobB")
+    proc = subprocess.Popen(_daemon_cmd(box), env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    ckpt = os.path.join(box, "ckpt")
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if (os.path.isdir(ckpt)
+                    and any(n.endswith(".ck")
+                            for n in os.listdir(ckpt))):
+                break
+            if proc.poll() is not None:
+                pytest.fail("daemon exited before any durable "
+                            "checkpoint was written")
+            time.sleep(0.2)
+        else:
+            pytest.fail("no durable checkpoint appeared in time")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert not os.path.exists(os.path.join(box, "summary.json"))
+
+    # restart on the same inbox: journal recovery + checkpoint resume
+    subprocess.run(_daemon_cmd(box), check=True, env=env,
+                   capture_output=True, timeout=420)
+    got, doc = _wirelengths(box)
+    assert got == ref, (f"post-SIGKILL recovery changed QoR: "
+                        f"{got} vs solo {ref}")
+    assert doc["daemon"]["metrics"].get("route.daemon.recovered", 0) > 0
+    # and the doctor signs off on the whole story
+    r = subprocess.run([sys.executable, FLOW_DOCTOR, "--daemon-summary",
+                        os.path.join(box, "summary.json")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -122,6 +122,30 @@ def test_checkpoint_corrupt_injection_detected(tmp_path):
     assert st.load("j") == {"it": 10}
 
 
+def test_checkpoint_gc_orphaned_tmp_on_startup(tmp_path):
+    # a SIGKILL between the tmp write and the rename leaks <id>.ck.tmp;
+    # a crash loop leaks them without bound.  Startup GC removes ONLY
+    # the store's own orphans, never live checkpoints or foreign files.
+    st = CheckpointStore(str(tmp_path))
+    st.save("live", {"it": 7})
+    for name in ("dead1.ck.tmp", "dead2.ck.tmp"):
+        with open(os.path.join(str(tmp_path), name), "wb") as f:
+            f.write(b"torn mid-write")
+    with open(os.path.join(str(tmp_path), "notes.txt"), "w") as f:
+        f.write("keep me")
+    set_metrics(MetricsRegistry())
+    st2 = CheckpointStore(str(tmp_path))
+    left = sorted(os.listdir(str(tmp_path)))
+    assert "dead1.ck.tmp" not in left and "dead2.ck.tmp" not in left
+    assert "notes.txt" in left
+    assert st2.load("live") == {"it": 7}
+    assert _vals()["route.resil.checkpoint_gc"] == 2
+    # idempotent: a clean startup GCs nothing and counts nothing
+    set_metrics(MetricsRegistry())
+    CheckpointStore(str(tmp_path))
+    assert "route.resil.checkpoint_gc" not in _vals()
+
+
 # ---- dispatch guard (fake clock + recorded sleeps; no jax) ---------
 
 class _Clock:

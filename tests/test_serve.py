@@ -130,6 +130,58 @@ def test_queue_preemption_round_robin():
         "route.serve.")["route.serve.jobs_preempted"] == 4
 
 
+def test_queue_aging_prevents_starvation():
+    # a steady stream of priority-5 work must not starve an old
+    # priority-0 job: with aging_rate=1 the old job's effective
+    # priority overtakes any high-priority job admitted >5s later
+    # (static heap key r*t_admit - p keeps the order time-invariant)
+    now = [0.0]
+    q = JobQueue(clock=lambda: now[0], aging_rate=1.0)
+    old = q.admit(_job(priority=0))
+    fresh = []
+    for _ in range(4):
+        now[0] += 2.0
+        fresh.append(q.admit(_job(priority=5)))
+    assert q.effective_priority(old) == pytest.approx(8.0)
+    ran = []
+    q.run(lambda j: (ran.append(j.job_id), ("done", None))[1])
+    # hi jobs admitted at t=2,4 still beat it; the t=6,8 ones don't
+    assert ran.index(old.job_id) == 2
+    assert ran == [fresh[0].job_id, fresh[1].job_id, old.job_id,
+                   fresh[2].job_id, fresh[3].job_id]
+
+    # aging_rate=0 (the default) is exactly the old strict-priority
+    # behavior: the low-priority job starves to the back of the line
+    q0 = JobQueue(clock=lambda: now[0], aging_rate=0.0)
+    old0 = q0.admit(_job(priority=0))
+    for _ in range(4):
+        now[0] += 2.0
+        q0.admit(_job(priority=5))
+    ran0 = []
+    q0.run(lambda j: (ran0.append(j.job_id), ("done", None))[1])
+    assert ran0.index(old0.job_id) == 4
+
+
+def test_queue_idempotent_resubmission():
+    q = JobQueue()
+    a = q.admit(_job(job_id="jobA", priority=3))
+    assert q.depth() == 1
+    # replaying the same submission while queued returns the SAME job
+    # and adds no heap entry
+    dup = q.admit(_job(job_id="jobA", priority=0))
+    assert dup is a and dup.priority == 3
+    assert q.depth() == 1
+    q.run(lambda j: ("done", None))
+    assert a.state is JobState.DONE
+    # replaying after completion must not resurrect or re-run it
+    dup2 = q.admit(_job(job_id="jobA"))
+    assert dup2 is a and a.state is JobState.DONE
+    assert q.depth() == 0
+    v = get_metrics().values("route.serve.")
+    assert v["route.serve.jobs_admitted"] == 1
+    assert v["route.serve.jobs_deduped"] == 2
+
+
 # ---- batcher -------------------------------------------------------
 
 def test_batcher_strict_demux():

@@ -39,6 +39,14 @@ Checks, each skipped (with a note) when its artifact is not given:
            exceed the trajectory median.  Cross-backend rows and
            pre_pr2 imports never enter the median; a scenario with no
            same-backend history skips with a note
+  daemon   (--daemon-summary FILE) the route daemon's exit summary
+           (serve/daemon_cli.py run --summary): every rejection and
+           every shed job must carry a machine-readable reason/cause,
+           shedding must coincide with recorded overload cycles, the
+           heartbeat must have no gap beyond its declared interval
+           band, and recovered jobs must be backed by a journal that
+           actually wrote — a daemon that drops work silently or
+           claims recovery without durable state is UNHEALTHY
   lint     (--lint [--lint-root DIR]) the graft-lint static rule set
            (parallel_eda_tpu/analysis): donation safety, jit-signature
            drift, determinism, durable-write atomicity, metric-name
@@ -420,6 +428,94 @@ def check_resil(doc: dict) -> tuple:
     return errs, notes
 
 
+# a beat may be late by this factor x interval before the doctor calls
+# the daemon's liveness claim a lie (scheduling jitter is real; a 10x
+# stall under a 1s interval is not jitter)
+HEARTBEAT_GAP_FACTOR = 10.0
+
+
+def check_daemon(doc: dict) -> tuple:
+    """Daemon rule set over a daemon summary JSON (serve/daemon_cli.py
+    ``run --summary``).  Returns (errors, notes).  The rules catch a
+    daemon that drops or invents work silently:
+
+      * a REJECTED submission without a machine-readable reason
+        ({"code": ...}) — the admission controller must never ghost a
+        client;
+      * a SHED job without an overload cause, or any shedding while
+        the daemon never recorded an overloaded cycle — eviction must
+        be traceable to measured overload, not mood;
+      * a heartbeat gap beyond HEARTBEAT_GAP_FACTOR x the declared
+        interval (or an uptime with no beats at all) — the daemon
+        claimed liveness it did not have;
+      * recovered jobs without a journal that exists and wrote — a
+        recovery story with no durable state behind it.
+    """
+    errs, notes = [], []
+    d = doc.get("daemon")
+    if not isinstance(d, dict):
+        return (["daemon-summary: no daemon section (not a daemon "
+                 "summary JSON?)"], notes)
+    vals = d.get("metrics") or {}
+
+    def g(k):
+        return vals.get("route.daemon." + k) or 0
+
+    jobs = doc.get("jobs") or []
+    rejected = [j for j in jobs if j.get("state") == "rejected"]
+    for j in rejected:
+        reason = j.get("reject_reason")
+        if not (isinstance(reason, dict) and reason.get("code")):
+            errs.append(f"daemon: job {j.get('job_id')} rejected "
+                        f"without a machine-readable reason "
+                        f"(got {reason!r})")
+    shed = [j for j in jobs if j.get("state") == "shed"]
+    for j in shed:
+        cause = j.get("shed_cause")
+        if not (isinstance(cause, dict) and cause.get("code")):
+            errs.append(f"daemon: job {j.get('job_id')} shed without "
+                        f"an overload cause (got {cause!r})")
+    if shed and not g("overloaded_cycles"):
+        errs.append(f"daemon: {len(shed)} job(s) shed but the daemon "
+                    f"never recorded an overloaded cycle — load was "
+                    f"dropped without measured overload")
+    hb = d.get("heartbeat") or {}
+    interval = hb.get("interval_s")
+    beats = hb.get("beats", 0)
+    gap = hb.get("max_gap_s", 0)
+    uptime = d.get("uptime_s", 0)
+    if isinstance(interval, (int, float)) and interval > 0:
+        if (not beats and isinstance(uptime, (int, float))
+                and uptime > interval):
+            errs.append(f"daemon: {uptime}s of uptime with zero "
+                        f"heartbeats (interval {interval}s) — the "
+                        f"liveness file never existed")
+        elif (isinstance(gap, (int, float))
+                and gap > HEARTBEAT_GAP_FACTOR * interval):
+            errs.append(f"daemon: worst heartbeat gap {gap}s exceeds "
+                        f"{HEARTBEAT_GAP_FACTOR:.0f}x the declared "
+                        f"{interval}s interval — the daemon claimed "
+                        f"liveness it did not have")
+    recovered = [j for j in jobs if j.get("recovered")]
+    n_rec = max(len(recovered), int(g("recovered")))
+    if n_rec:
+        jr = d.get("journal") or {}
+        if not (jr.get("file") and (jr.get("writes") or 0) > 0
+                and (jr.get("entries") or 0) > 0):
+            errs.append(f"daemon: {n_rec} job(s) claim recovery but "
+                        f"the journal section shows no durable state "
+                        f"(file={jr.get('file')!r} "
+                        f"writes={jr.get('writes')} "
+                        f"entries={jr.get('entries')})")
+    inbox = d.get("inbox") or {}
+    notes.append(f"daemon: cycles={d.get('cycles')} "
+                 f"uptime={uptime}s beats={beats} max_gap={gap}s "
+                 f"admitted={g('admitted')} rejected={len(rejected)} "
+                 f"shed={len(shed)} recovered={n_rec} "
+                 f"torn_inbox_lines={inbox.get('torn_lines', 0)}")
+    return errs, notes
+
+
 def check_lint(root=None):
     """Run the graft-lint static rule set (parallel_eda_tpu/analysis —
     stdlib-only like this tool) over the source tree.  Every live
@@ -485,6 +581,11 @@ def main(argv=None) -> int:
                     help="serve CLI summary JSON to gate with the "
                          "resil rule set (quarantine provenance, "
                          "retry bounds, failure diagnosability)")
+    ap.add_argument("--daemon-summary", dest="daemon_summary",
+                    help="route daemon summary JSON to gate with the "
+                         "daemon rule set (rejection reasons, shed "
+                         "causes vs measured overload, heartbeat "
+                         "gaps, recovery provenance)")
     ap.add_argument("--lint", action="store_true",
                     help="run the graft-lint static rule set over the "
                          "source tree (donation safety, signature "
@@ -496,10 +597,11 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if not any((args.trace, args.metrics, args.devprof, args.row,
-                args.corpus, args.serve_summary, args.lint)):
+                args.corpus, args.serve_summary, args.daemon_summary,
+                args.lint)):
         ap.error("nothing to check: give at least one of --trace / "
                  "--metrics / --devprof / --row / --corpus / "
-                 "--serve-summary / --lint")
+                 "--serve-summary / --daemon-summary / --lint")
 
     errs, notes = [], []
     try:
@@ -557,6 +659,10 @@ def main(argv=None) -> int:
             se, sn = check_resil(_read_json(args.serve_summary))
             errs += se
             notes += sn
+        if args.daemon_summary:
+            de, dn = check_daemon(_read_json(args.daemon_summary))
+            errs += de
+            notes += dn
         if args.lint:
             le, ln = check_lint(args.lint_root)
             errs += le

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Long-lived route daemon front end (thin wrapper).
+
+Same CLI as `python -m parallel_eda_tpu daemon` — the implementation
+lives in parallel_eda_tpu/serve/daemon_cli.py; this script only makes
+it runnable from a checkout without installing the package:
+
+    python tools/route_daemon.py run --inbox box/ --luts 10 \
+        --exit_when_idle 5 --summary box/summary.json
+    python tools/route_daemon.py submit --inbox box/ --seed 3
+    python tools/route_daemon.py status --inbox box/
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from parallel_eda_tpu.serve.daemon_cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
