@@ -171,8 +171,15 @@ def _config_key(args) -> str:
     from parallel_eda_tpu.route import RouterOpts as _RO
     div = (f"_d{args.budget_div}"
            if args.budget_div != _RO().sweep_budget_div else "")
+    # same stability rule for the PR-11 kernel knobs: suffix only when
+    # they leave the default, so the f32/per-rung config of record
+    # keeps its scenario id (and its recorded on-chip rows)
+    pd = (f"_p{args.plane_dtype}"
+          if getattr(args, "plane_dtype", "f32") != "f32" else "")
+    fu = "_fused" if getattr(args, "fused_dispatch", False) else ""
     return (f"scale{int(bool(args.scale))}_l{args.luts}"
-            f"_w{args.chan_width}_{args.program}_b{args.batch}{div}")
+            f"_w{args.chan_width}_{args.program}_b{args.batch}"
+            f"{div}{pd}{fu}")
 
 
 def _recorded_path(args) -> str:
@@ -242,7 +249,12 @@ def emit(args, line: dict, gauges=None, series=None,
             line.get("unit", "none"), backend, line["device_kind"],
             qor=qor, gauges=gauges, series=series,
             congestion=congestion, detail=detail or None,
-            tags=tags or None, ts=line["ts"], rev=line["git_rev"])
+            tags=tags or None, ts=line["ts"], rev=line["git_rev"],
+            # absent means f32 (pre-dtype-era rows stay valid), so
+            # only non-default dtypes are stamped
+            plane_dtype=(args.plane_dtype
+                         if getattr(args, "plane_dtype", "f32") != "f32"
+                         else None))
         path = rs.append_run(getattr(args, "runs_dir", "runs"), rec)
         log(f"corpus: appended {scenario} row to {path}")
     except Exception as e:
@@ -601,6 +613,20 @@ def main():
     ap.add_argument("--trace_out", default=None,
                     help="export a Chrome trace-event JSON of the "
                          "measured route to this path (obs tracer)")
+    ap.add_argument("--plane_dtype", default="f32",
+                    choices=("f32", "bf16"),
+                    help="distance/backtrack plane storage dtype "
+                         "(bf16 halves the modeled plane traffic; "
+                         "guarded modes stay QoR-bit-exact)")
+    ap.add_argument("--dtype_guard", default="window",
+                    choices=("window", "route", "off"),
+                    help="bf16 exactness guard: per-window oracle "
+                         "compare, until-first-clean-window, or off "
+                         "(perf mode, commits bf16)")
+    ap.add_argument("--fused_dispatch", action="store_true",
+                    help="one ragged packed window program walking "
+                         "every populated crop rung instead of one "
+                         "dispatch per rung")
     args = ap.parse_args()
     serial_error = None
     if args.budget_div is None:
@@ -678,7 +704,9 @@ def main():
     router = Router(rr, RouterOpts(
         batch_size=args.batch, program=args.program,
         sweep_budget_div=args.budget_div, pipeline=not args.sync,
-        compile_cache_dir=args.compile_cache_dir))
+        compile_cache_dir=args.compile_cache_dir,
+        plane_dtype=args.plane_dtype, dtype_guard=args.dtype_guard,
+        fused_dispatch=args.fused_dispatch))
     from parallel_eda_tpu.obs import (compile_seconds, get_metrics,
                                       reset_compile_seconds)
     c0 = compile_seconds()

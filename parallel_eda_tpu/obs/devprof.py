@@ -216,6 +216,12 @@ class DevProfiler:
         modeled = dom.get("meta", {}).get("bytes_per_sweep")
         if modeled is not None:
             out["modeled_bytes_per_sweep"] = modeled
+        # the modeled row is dtype-aware (router._plan_block_nets byte
+        # formulas scale with the plane storage itemsize); carry the
+        # dtype so a bytes_delta is never compared across dtypes
+        pd = dom.get("meta", {}).get("plane_dtype")
+        if pd is not None:
+            out["plane_dtype"] = pd
         return out
 
     def dump(self, path: str) -> None:

@@ -58,8 +58,10 @@ REQUIRED_FIELDS = (
 )
 
 # optional string fields: validated for type when present, never
-# required (the v2 tenancy columns)
-OPTIONAL_STR_FIELDS = ("tenant", "job_id")
+# required (the v2 tenancy columns, plus the plane storage dtype a
+# reduced-precision run routed with — absent means f32, so v1/v2 rows
+# written before the dtype era stay valid and comparable)
+OPTIONAL_STR_FIELDS = ("tenant", "job_id", "plane_dtype")
 
 _SCENARIO_OK = re.compile(r"[^A-Za-z0-9._-]+")
 
@@ -112,7 +114,8 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
                 rev: Optional[str] = None,
                 repo_dir: Optional[str] = None,
                 tenant: Optional[str] = None,
-                job_id: Optional[str] = None) -> dict:
+                job_id: Optional[str] = None,
+                plane_dtype: Optional[str] = None) -> dict:
     rec = {
         "schema_version": SCHEMA_VERSION,
         "ts": ts or now_iso(),
@@ -129,6 +132,8 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
         rec["tenant"] = str(tenant)
     if job_id is not None:
         rec["job_id"] = str(job_id)
+    if plane_dtype is not None:
+        rec["plane_dtype"] = str(plane_dtype)
     for key, val in (("qor", qor), ("gauges", gauges),
                      ("series", series), ("congestion", congestion),
                      ("detail", detail), ("tags", tags)):

@@ -802,7 +802,56 @@ def _sweep_once(gm: PlanesGeom, s, crit_c, cc_x, cc_y, costs):
     return dx, dy, predx, predy, wx, wy
 
 
-def _run_relax(sweep_fn, state0, nsweeps: int):
+# Storage dtypes of the distance/backtrack planes.  "f32" is the
+# bit-exact oracle.  "bf16" halves the bytes every sweep's loop-carried
+# state moves (and doubles effective lane width in the packed layout):
+# the dist/wenter canvases are CARRIED in bfloat16 between sweeps while
+# every sweep body still runs in f32 — the wavefront-min reduction (the
+# min-plus scans and turn folds) accumulates in f32 and only the
+# per-sweep requantization rounds.  pred stays int32 (exact global cell
+# indices) and crit stays f32; the congestion input is quantized ONCE
+# through the plane dtype (see planes_relax) so the XLA and Pallas
+# lowerings see identical costs and remain bit-identical to each other
+# in either mode.
+PLANE_DTYPES = ("f32", "bf16")
+
+
+def plane_jnp_dtype(plane_dtype: str):
+    """jnp storage dtype of a plane-dtype name."""
+    if plane_dtype not in PLANE_DTYPES:
+        raise ValueError(
+            f"plane_dtype must be one of {PLANE_DTYPES}, "
+            f"got {plane_dtype!r}")
+    return jnp.bfloat16 if plane_dtype == "bf16" else jnp.float32
+
+
+def plane_itemsize(plane_dtype: str) -> int:
+    """Storage bytes per plane cell — the dtype-aware byte-budget and
+    modeled-traffic multiplier (PackedLayout / kernel_bench / devprof
+    all derive from this one function)."""
+    return 2 if plane_dtype == "bf16" else 4
+
+
+def quantize_plane_state(s, plane_dtype: str):
+    """(dx, dy, predx, predy, wx, wy) -> storage dtypes: the dist and
+    wenter payloads take the plane dtype (round-to-nearest), pred stays
+    int32.  A no-op cast when the state already carries the dtype, so
+    the Pallas kernels (whose refs are already storage-dtype) and the
+    XLA programs (f32 inputs) quantize identically."""
+    dt = plane_jnp_dtype(plane_dtype)
+    dx, dy, px, py, wx, wy = s
+    return (dx.astype(dt), dy.astype(dt), px, py,
+            wx.astype(dt), wy.astype(dt))
+
+
+def _dequantize_plane_state(s):
+    dx, dy, px, py, wx, wy = s
+    f32 = jnp.float32
+    return (dx.astype(f32), dy.astype(f32), px, py,
+            wx.astype(f32), wy.astype(f32))
+
+
+def _run_relax(sweep_fn, state0, nsweeps: int, plane_dtype: str = "f32"):
     """Run ``sweep_fn`` to the fixpoint or ``nsweeps`` times, whichever
     comes first, via a bounded ``lax.while_loop``.
 
@@ -814,6 +863,14 @@ def _run_relax(sweep_fn, state0, nsweeps: int):
     The static ``nsweeps`` stays as the trip-count ceiling so the
     tunneled backend still sees a bounded loop.
 
+    With ``plane_dtype="bf16"`` the loop-carried dist/wenter state is
+    stored in bfloat16: each trip upcasts to f32, runs the f32 sweep
+    body, and requantizes.  The fixpoint test compares the QUANTIZED
+    distances — still exact, because round-to-nearest of a value below
+    a bf16 number cannot round above it, so quantized distances stay
+    monotone non-increasing and "unchanged" still implies every further
+    trip is an identity.
+
     Returns (state, stats) with stats = int32[2] (sweeps executed,
     sweeps useful).  A sweep is "useful" if it changed some distance;
     the one extra sweep spent discovering the fixpoint is counted as
@@ -824,11 +881,21 @@ def _run_relax(sweep_fn, state0, nsweeps: int):
         i, go, _ = carry
         return go & (i < nsweeps)
 
-    def body(carry):
-        i, _, s = carry
-        s2 = sweep_fn(s)
-        changed = (jnp.any(s2[0] < s[0]) | jnp.any(s2[1] < s[1]))
-        return i + 1, changed, s2
+    if plane_dtype != "f32":
+        state0 = quantize_plane_state(state0, plane_dtype)
+
+        def body(carry):
+            i, _, s = carry
+            s2 = quantize_plane_state(
+                sweep_fn(_dequantize_plane_state(s)), plane_dtype)
+            changed = (jnp.any(s2[0] < s[0]) | jnp.any(s2[1] < s[1]))
+            return i + 1, changed, s2
+    else:
+        def body(carry):
+            i, _, s = carry
+            s2 = sweep_fn(s)
+            changed = (jnp.any(s2[0] < s[0]) | jnp.any(s2[1] < s[1]))
+            return i + 1, changed, s2
 
     i, go, state = lax.while_loop(
         cond, body, (jnp.int32(0), jnp.bool_(True), state0))
@@ -837,7 +904,7 @@ def _run_relax(sweep_fn, state0, nsweeps: int):
 
 
 def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
-                 nsweeps: int, mesh=None):
+                 nsweeps: int, mesh=None, plane_dtype: str = "f32"):
     """Fixed-sweep planes relaxation with predecessor tracking.
 
     d0_flat [B, Ncells] seeded initial distances (pred of a seeded cell is
@@ -885,6 +952,15 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
     dy = cshard(d0_flat[:, ncx:].reshape(B, W, NXp1, NY))
     cc_x = cshard(cc_flat[:, :ncx].reshape(B, W, NX, NYp1))
     cc_y = cshard(cc_flat[:, ncx:].reshape(B, W, NXp1, NY))
+    if plane_dtype != "f32":
+        # quantize the congestion input ONCE through the plane dtype
+        # (round trip back to f32 for the sweep body): the Pallas
+        # lowering stores its cc refs in the storage dtype, so both
+        # lowerings must see the same rounded costs to stay
+        # bit-identical to each other in reduced-precision mode
+        dt = plane_jnp_dtype(plane_dtype)
+        cc_x = cc_x.astype(dt).astype(jnp.float32)
+        cc_y = cc_y.astype(dt).astype(jnp.float32)
 
     gm = geom_full(pg)
     predx = jnp.broadcast_to(gm.idxx, dx.shape)
@@ -901,7 +977,12 @@ def planes_relax(pg: PlanesGraph, d0_flat, cc_flat, crit_c, wenter0,
         return tuple(cshard(t) for t in s)
 
     (dx, dy, predx, predy, wx, wy), stats = _run_relax(
-        sweep, (dx, dy, predx, predy, wx, wy), nsweeps)
+        sweep, (dx, dy, predx, predy, wx, wy), nsweeps, plane_dtype)
+    if plane_dtype != "f32":
+        # downstream (sink extraction, traceback, delay accumulation)
+        # consumes f32 flats regardless of the storage dtype
+        dx, dy, wx, wy = (a.astype(jnp.float32)
+                          for a in (dx, dy, wx, wy))
 
     def flat(a, b):
         return jnp.concatenate([a.reshape(B, -1), b.reshape(B, -1)],
@@ -1001,7 +1082,7 @@ def unfold_canvas(a2, shape, pad_y: int = 0):
 
 def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
                          wenter0, nsweeps: int, ox, oy,
-                         cnx: int, cny: int):
+                         cnx: int, cny: int, plane_dtype: str = "f32"):
     """planes_relax on per-net (cnx, cny) CROPPED canvases: net b sweeps
     only the tile starting at grid cell (ox[b], oy[b]) — work per net
     scales with its bounding box, not the device (the reference's
@@ -1019,6 +1100,11 @@ def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
     gm = geom_cropped(pg, ox, oy, cnx, cny, full=gm_full)
     fulls, (dx, dy, cc_x, cc_y, wx, wy) = crop_state(
         pg, d0_flat, cc_flat, wenter0, ox, oy, cnx, cny)
+    if plane_dtype != "f32":
+        # same one-time congestion quantization as planes_relax
+        dt = plane_jnp_dtype(plane_dtype)
+        cc_x = cc_x.astype(dt).astype(jnp.float32)
+        cc_y = cc_y.astype(dt).astype(jnp.float32)
     predx = jnp.broadcast_to(gm.idxx, dx.shape)
     predy = jnp.broadcast_to(gm.idxy, dy.shape)
 
@@ -1028,7 +1114,9 @@ def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
         return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
     tiles, stats = _run_relax(sweep, (dx, dy, predx, predy, wx, wy),
-                              nsweeps)
+                              nsweeps, plane_dtype)
+    if plane_dtype != "f32":
+        tiles = _dequantize_plane_state(tiles)
     # scatter the tiles back into the full canvases (one full-canvas
     # write per relaxation instead of ~15 traversals per sweep)
     return scatter_state(gm_full, fulls, tiles, ox, oy) + (stats,)
@@ -1051,7 +1139,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                nsweeps: int, max_len: int, num_waves: int, group: int,
                doubling: bool, mesh, use_pallas: bool = False,
                crop_tile=None, bb0_all=None, widen_ok=None,
-               pallas_g1: bool = False):
+               pallas_g1: bool = False, plane_dtype: str = "f32"):
     """One fused batch step (traceable body shared by the standalone
     per-batch wrapper and the window program): rip up the selected nets,
     re-route each against the occupancy view of everyone-but-itself with
@@ -1210,20 +1298,24 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                 dist, pred, wenter, rst = planes_relax_cropped_pallas(
                     pg, d0, cc_flat, crit_c, wenter0, nsweeps,
                     crop_ox, crop_oy, cnx_t, cny_t,
-                    block_nets=1 if pallas_g1 else None)
+                    block_nets=1 if pallas_g1 else None,
+                    plane_dtype=plane_dtype)
             else:
                 from .planes_pallas import planes_relax_pallas
                 dist, pred, wenter, rst = planes_relax_pallas(
                     pg, d0, cc_flat, crit_c, wenter0, nsweeps,
-                    block_nets=1 if pallas_g1 else None)
+                    block_nets=1 if pallas_g1 else None,
+                    plane_dtype=plane_dtype)
         elif crop_tile is not None:
             dist, pred, wenter, rst = planes_relax_cropped(
                 pg, d0, cc_flat, crit_c, wenter0, nsweeps,
-                crop_ox, crop_oy, cnx_t, cny_t)
+                crop_ox, crop_oy, cnx_t, cny_t,
+                plane_dtype=plane_dtype)
         else:
             dist, pred, wenter, rst = planes_relax(pg, d0, cc_flat,
                                                    crit_c, wenter0,
-                                                   nsweeps, mesh)
+                                                   nsweeps, mesh,
+                                                   plane_dtype)
         st = st + rst
 
         # --- sink extraction from the per-net candidate tables ---
@@ -1443,7 +1535,8 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
 @functools.partial(
     jax.jit,
     static_argnames=("nsweeps", "max_len", "num_waves", "group",
-                     "doubling", "mesh", "use_pallas", "crop_tile"),
+                     "doubling", "mesh", "use_pallas", "crop_tile",
+                     "plane_dtype"),
     donate_argnames=("occ", "paths", "sink_delay", "all_reached", "bb"))
 def route_batch_resident_planes(
         pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
@@ -1455,7 +1548,7 @@ def route_batch_resident_planes(
         sel, valid, full_bb,
         nsweeps: int, max_len: int, num_waves: int, group: int,
         doubling: bool = False, mesh=None, use_pallas: bool = False,
-        crop_tile=None, bb0_all=None):
+        crop_tile=None, bb0_all=None, plane_dtype: str = "f32"):
     """Standalone one-batch wrapper of _step_core (resident-state
     contract of search.route_batch_resident; the host picked the nets,
     so force=True)."""
@@ -1473,7 +1566,7 @@ def route_batch_resident_planes(
         direct_oidx_all, direct_ipin_all, direct_delay_all,
         sel, valid, jnp.bool_(True), full_bb,
         nsweeps, max_len, num_waves, group, doubling, mesh, use_pallas,
-        crop_tile, bb0_all)
+        crop_tile, bb0_all, plane_dtype=plane_dtype)
     return (paths, sink_delay, all_reached, bb, occ, st_exec)
 
 
@@ -1526,15 +1619,10 @@ WINDOW_STATIC_ARGNAMES = ("K_iters", "nsweeps", "max_len", "num_waves",
                           "group", "doubling", "topk", "n_colors",
                           "mesh", "sta_depth", "crit_exp", "max_crit",
                           "use_sdc", "use_pallas", "crop_tile",
-                          "pallas_g1")
+                          "pallas_g1", "plane_dtype")
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=WINDOW_STATIC_ARGNAMES,
-    donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
-                     "bb", "crit_all"))
-def route_window_planes(
+def _window_body(
         pg: PlanesGraph, dev: DeviceRRGraph, occ, acc,
         paths, sink_delay, all_reached, bb,
         source_all, sinks_all, crit_all,
@@ -1550,7 +1638,7 @@ def route_window_planes(
         crit_exp: float = 1.0, max_crit: float = 0.99,
         use_sdc: bool = False, use_pallas: bool = False,
         crop_tile=None, bb0_all=None, widen_ok=None,
-        pallas_g1: bool = False):
+        pallas_g1: bool = False, plane_dtype: str = "f32"):
     """A WINDOW of K_iters complete PathFinder iterations as ONE device
     program: per iteration, every batch group in sel_plan [G, B] runs the
     fused rip-up/route/commit step (clean nets no-op via the device-side
@@ -1607,7 +1695,8 @@ def route_window_planes(
                     direct_oidx_all, direct_ipin_all, direct_delay_all,
                     sel_plan[g], valid_plan[g], force, full_bb,
                     nsweeps, max_len, num_waves, group, doubling, mesh,
-                    use_pallas, crop_tile, bb0_all, widen_ok, pallas_g1)
+                    use_pallas, crop_tile, bb0_all, widen_ok, pallas_g1,
+                    plane_dtype)
                 return (occ2, paths2, sink_delay2, all_reached2, bb2,
                         nr + n_act, ng + 1, se + st_exec, su + st_useful)
 
@@ -1703,6 +1792,140 @@ def route_window_planes(
             colors, n_over_s, over_tot_s, nroutes, nexec, crit_all,
             dmax_hist, max_span, dev_wide, live_wh, unreached,
             s_exec, s_useful, status, scal)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=WINDOW_STATIC_ARGNAMES,
+    donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
+                     "bb", "crit_all"))
+def route_window_planes(
+        pg: PlanesGraph, dev: DeviceRRGraph, occ, acc,
+        paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
+        sel_plan, valid_plan, full_bb,
+        pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+        K_iters: int, nsweeps: int, max_len: int, num_waves: int,
+        group: int, doubling: bool = True, topk: int = 1024,
+        n_colors: int = 5, mesh=None,
+        tdev=None, req_seed=None, sta_depth: int = 0,
+        crit_exp: float = 1.0, max_crit: float = 0.99,
+        use_sdc: bool = False, use_pallas: bool = False,
+        crop_tile=None, bb0_all=None, widen_ok=None,
+        pallas_g1: bool = False, plane_dtype: str = "f32"):
+    """One window RUNG as its own jit program (contract: _window_body's
+    docstring) — the per-rung dispatch shape the Router's crop ladder
+    used before the fused program below, kept as the watchdog fallback
+    and the bit-exactness reference of the fused mode."""
+    return _window_body(
+        pg, dev, occ, acc, paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
+        sel_plan, valid_plan, full_bb,
+        pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+        K_iters, nsweeps, max_len, num_waves, group, doubling, topk,
+        n_colors, mesh, tdev, req_seed, sta_depth, crit_exp, max_crit,
+        use_sdc, use_pallas, crop_tile, bb0_all, widen_ok, pallas_g1,
+        plane_dtype)
+
+
+# the fused program's static argnames: the per-rung statics
+# (crop_tile / nsweeps / num_waves / group / doubling) move into the
+# ragged ``rung_desc`` descriptor table; everything else is shared with
+# the per-rung program.  serve/library.py resolves a function's static
+# split via its ``_static_argnames`` attribute (set below), falling
+# back to WINDOW_STATIC_ARGNAMES for the legacy per-rung program.
+FUSED_WINDOW_STATIC_ARGNAMES = tuple(
+    n for n in WINDOW_STATIC_ARGNAMES
+    if n not in ("nsweeps", "num_waves", "group", "doubling",
+                 "crop_tile")) + ("rung_desc",)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=FUSED_WINDOW_STATIC_ARGNAMES,
+    donate_argnames=("occ", "acc", "paths", "sink_delay", "all_reached",
+                     "bb", "crit_all"))
+def route_window_planes_fused(
+        pg: PlanesGraph, dev: DeviceRRGraph, occ, acc,
+        paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
+        sel_plans, valid_plans, full_bb,
+        pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+        K_iters: int, max_len: int, rung_desc=(), topk: int = 1024,
+        n_colors: int = 5, mesh=None,
+        tdev=None, req_seed=None, sta_depth: int = 0,
+        crit_exp: float = 1.0, max_crit: float = 0.99,
+        use_sdc: bool = False, use_pallas: bool = False,
+        bb0_all=None, widen_oks=None,
+        pallas_g1: bool = False, plane_dtype: str = "f32"):
+    """The WHOLE window dispatch ladder as ONE device program: walk the
+    ragged ``rung_desc`` descriptor table — one static
+    (crop_tile, nsweeps, num_waves, group, doubling) tuple per
+    populated size-class rung — running each rung's _window_body on its
+    own sel/valid plan and threading the negotiation state
+    (occ/acc/paths/sink_delay/all_reached/bb/crit_all) rung to rung,
+    exactly as the per-rung dispatch loop does host-side.  One dispatch
+    per window replaces one per populated rung, killing the
+    per-dispatch overhead devprof flags on small-window variants.
+
+    Each rung keeps ITS OWN static shapes inside the one XLA program
+    (the descriptor is static, so the trace unrolls per rung) — this is
+    what preserves bit-exactness vs the per-rung loop: a common-tile
+    ragged kernel would pad associative-scan axes and change the
+    min-plus combine tree.  The acc escalation applies on rung 0 only
+    and pres re-escalates identically per rung from the same pres0,
+    mirroring the host loop's esc=True-then-False protocol.
+
+    Returns the last rung's 23-tuple (the window summary the control
+    loop consumes) plus a stacked [n_rungs, SCAL_LEN] int32 of every
+    rung's ``scal`` vector as a 24th element — the per-rung ledger rows
+    _book_window would otherwise have collected per dispatch."""
+    if widen_oks is None:
+        widen_oks = (None,) * len(rung_desc)
+    out = None
+    scals = []
+    for r, (crop_tile, nsweeps, num_waves, group,
+            doubling) in enumerate(rung_desc):
+        out = _window_body(
+            pg, dev, occ, acc, paths, sink_delay, all_reached, bb,
+            source_all, sinks_all, crit_all,
+            opin_node_all, entry_cell_all, entry_oidx_all,
+            entry_delay_all, sink_uid_all, uid_cell, uid_ipin,
+            uid_delay, direct_oidx_all, direct_ipin_all,
+            direct_delay_all,
+            sel_plans[r], valid_plans[r], full_bb,
+            pres0, pres_mult, max_pres,
+            acc_fac if r == 0 else jnp.float32(0.0),
+            it0, force_until,
+            K_iters, nsweeps, max_len, num_waves, group, doubling,
+            topk, n_colors, mesh, tdev, req_seed, sta_depth, crit_exp,
+            max_crit, use_sdc, use_pallas, crop_tile, bb0_all,
+            widen_oks[r], pallas_g1, plane_dtype)
+        (occ, acc, paths, sink_delay, all_reached, bb) = out[:6]
+        crit_all = out[13]
+        scals.append(out[22])
+    return out + (jnp.stack(scals),)
+
+
+try:
+    # the AOT library's static/dynamic arg split reads this attribute;
+    # jax's jit wrapper may reject attribute writes on some versions,
+    # in which case library._static_names falls back to matching the
+    # function by name
+    route_window_planes_fused._static_argnames = \
+        FUSED_WINDOW_STATIC_ARGNAMES
+    route_window_planes._static_argnames = WINDOW_STATIC_ARGNAMES
+except (AttributeError, TypeError):          # pragma: no cover
+    pass
 
 
 # indices into the packed ``scal`` summary vector of route_window_planes

@@ -54,10 +54,13 @@ from jax.experimental import pallas as pl
 
 from .planes import (INF, PlanesGeom, PlanesGraph, _run_relax,
                      _sweep_costs, _sweep_once, crop_state, fold_canvas,
-                     geom_cropped, geom_full, scatter_state,
-                     unfold_canvas)
+                     geom_cropped, geom_full, plane_jnp_dtype,
+                     scatter_state, unfold_canvas)
 
-# f32 vector-register geometry (TPU: 8 sublanes x 128 lanes)
+# f32 vector-register geometry (TPU: 8 sublanes x 128 lanes; bf16 rows
+# stay legal because the packed [G, row] layout keeps the minor axis
+# lane-aligned — the bf16 min tile only grows the SUBLANE direction,
+# which the G axis covers)
 SUBLANE = 8
 LANE = 128
 DEF_LANE_MULT = 8           # trailing-Y pad granularity for packed rows
@@ -65,9 +68,35 @@ DEF_LANE_MULT = 8           # trailing-Y pad granularity for packed rows
 # scratch and compiler spills
 VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 # canvas-pair-equivalents of VMEM one net occupies during the in-kernel
-# sweep loop: 6 state inputs + 6 outputs double-buffered by the grid
-# pipeline (24) plus ~16 live scan/turn intermediates in the sweep body
-CANVAS_EQUIV = 40
+# sweep loop, split by what scales with the plane storage dtype: the 6
+# state inputs + 6 outputs double-buffered by the grid pipeline (24)
+# carry the storage dtype, while the ~16 live scan/turn intermediates
+# in the sweep body are f32 regardless (the bf16 mode upcasts per
+# sweep), so a bf16 block shrinks its buffers but not its temporaries
+BUFFER_EQUIV = 24
+SWEEP_TMP_EQUIV = 16
+CANVAS_EQUIV = BUFFER_EQUIV + SWEEP_TMP_EQUIV
+
+
+def packed_bytes_per_cell(itemsize: int = 4) -> int:
+    """Modeled HBM bytes one PADDED cell moves across a packed-kernel
+    dispatch: two traversals of each of the five storage-dtype canvas
+    sets (dist + wenter in and out, congestion in) plus two of the
+    int32 pred output.  itemsize=4 reproduces the round-5 f32 model
+    (2 * 6 * 4 = 48 B/cell) exactly; bf16 (itemsize=2) models 28 —
+    the dtype-aware bytes/sweep ledger and the route.kernel gauges both
+    derive from this one function."""
+    return 2 * (5 * int(itemsize) + 4)
+
+
+def xla_bytes_per_cell(itemsize: int = 4) -> int:
+    """Modeled HBM bytes one USEFUL cell moves per XLA sweep: ~15
+    canvas traversals, of which the three loop-carried storage sets
+    (dist, wenter, congestion) take the plane dtype while the scan and
+    turn intermediates XLA materialises stay f32 — the XLA lowering
+    barely benefits from bf16 (60 -> 54 B/cell); the packed kernel is
+    where the dtype lever pays."""
+    return 3 * int(itemsize) + 12 * 4
 
 
 def _ceil_to(n: int, m: int) -> int:
@@ -120,10 +149,14 @@ class PackedLayout:
     def padded_cells(self) -> int:
         return self.row_x + self.row_y
 
-    def block_bytes(self, G: int) -> int:
+    def block_bytes(self, G: int, itemsize: int = 4) -> int:
         """Modeled VMEM bytes of a G-net block while the sweep loop
-        runs (f32 canvases x CANVAS_EQUIV live pairs per net)."""
-        return int(G) * CANVAS_EQUIV * 4 * self.padded_cells
+        runs.  The buffered state scales with the plane storage dtype
+        (``itemsize``); the live sweep-body intermediates are f32 in
+        every mode (itemsize=4 collapses to the round-5 model,
+        CANVAS_EQUIV * 4 bytes per padded cell)."""
+        per_cell = BUFFER_EQUIV * int(itemsize) + SWEEP_TMP_EQUIV * 4
+        return int(G) * per_cell * self.padded_cells
 
     def lane_occupancy(self, G: int) -> float:
         """Useful-cell fraction of the vreg footprint of a [G, row]
@@ -141,13 +174,17 @@ def packed_layout(shape_x, shape_y,
 
 def auto_block_nets(shape_x, shape_y, nnets: int,
                     lane_mult: int = DEF_LANE_MULT,
-                    vmem_bytes: int = VMEM_BUDGET_BYTES) -> int:
+                    vmem_bytes: int = VMEM_BUDGET_BYTES,
+                    itemsize: int = 4) -> int:
     """Largest power-of-two block of nets whose packed state fits the
     VMEM plan budget, clamped to the batch.  Never below 1: a single
     net that overflows the budget still runs — the grid pipeline
-    streams its block with double-buffered HBM->VMEM copies."""
+    streams its block with double-buffered HBM->VMEM copies.  A
+    narrower plane dtype (``itemsize``) shrinks the per-net footprint,
+    so the same budget packs more nets per block — the lane-width
+    doubling of the bf16 mode."""
     lay = packed_layout(shape_x, shape_y, lane_mult)
-    per_net = max(1, lay.block_bytes(1))
+    per_net = max(1, lay.block_bytes(1, itemsize))
     g = max(1, vmem_bytes // per_net)
     return _pow2_floor(min(g, max(1, int(nnets))))
 
@@ -179,7 +216,7 @@ def _store_packed(ref, a, pad_y: int):
 
 
 def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int, G: int,
-                  pad_yx: int, pad_yy: int,
+                  pad_yx: int, pad_yy: int, plane_dtype: str,
                   # refs: per-net state, folded [G, row]
                   dx_ref, dy_ref, ccx_ref, ccy_ref, crit_ref, wx_ref,
                   wy_ref,
@@ -221,8 +258,12 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int, G: int,
 
     dx = _load_packed(dx_ref, G, shx, pad_yx)
     dy = _load_packed(dy_ref, G, shy, pad_yy)
-    cc_x = _load_packed(ccx_ref, G, shx, pad_yx)
-    cc_y = _load_packed(ccy_ref, G, shy, pad_yy)
+    # the congestion refs carry the plane storage dtype (real HBM/VMEM
+    # savings in bf16 mode); the sweep body always computes in f32 —
+    # the wrapper quantized cc through the same dtype the XLA program
+    # uses, so the upcast sees identical values in both lowerings
+    cc_x = _load_packed(ccx_ref, G, shx, pad_yx).astype(jnp.float32)
+    cc_y = _load_packed(ccy_ref, G, shy, pad_yy).astype(jnp.float32)
     crit_c = crit_ref[:].reshape(G, 1, 1, 1)
     wx = _load_packed(wx_ref, G, shx, pad_yx)
     wy = _load_packed(wy_ref, G, shy, pad_yy)
@@ -237,9 +278,12 @@ def _sweep_kernel(pg_template: PlanesGraph, nsweeps: int, G: int,
 
     # per-block bounded while_loop: the block stops at its members'
     # common fixpoint — the max of the member nets' own trip counts,
-    # the same reduction the batched XLA while_loop applies batch-wide
+    # the same reduction the batched XLA while_loop applies batch-wide.
+    # In bf16 mode the refs already carry the storage dtype, so
+    # _run_relax's entry quantization is a no-op cast and the per-sweep
+    # up/down cycle matches the XLA program bit for bit
     (dx, dy, predx, predy, wx, wy), stats = _run_relax(
-        body, (dx, dy, predx, predy, wx, wy), nsweeps)
+        body, (dx, dy, predx, predy, wx, wy), nsweeps, plane_dtype)
 
     _store_packed(odx_ref, dx, pad_yx)
     _store_packed(ody_ref, dy, pad_yy)
@@ -259,16 +303,21 @@ def _bpad(a, n: int, fill=0):
 
 
 @functools.partial(jax.jit, static_argnames=("nsweeps", "interpret",
-                                             "block_nets", "lane_mult"))
+                                             "block_nets", "lane_mult",
+                                             "plane_dtype"))
 def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
                         wenter0, nsweeps: int, interpret=None,
-                        block_nets=None, lane_mult: int = DEF_LANE_MULT):
+                        block_nets=None, lane_mult: int = DEF_LANE_MULT,
+                        plane_dtype: str = "f32"):
     """Drop-in for planes.planes_relax with identical signature and
     bit-identical results, lowered as a Pallas kernel gridded over
     BLOCKS of nets.  interpret=None auto-selects the interpreter
     off-TPU (tests/CPU); block_nets=None auto-plans the block size from
     the VMEM budget; block_nets=1 + lane_mult=1 is the legacy
-    one-net-per-step layout."""
+    one-net-per-step layout.  plane_dtype="bf16" stores the dist/
+    wenter/congestion refs (and their out_shapes) in bfloat16 — the
+    per-sweep state really moves half the bytes — and stays
+    bit-identical to planes_relax run with the same plane_dtype."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B = d0_flat.shape[0]
@@ -278,8 +327,10 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
     shx = (W, NX, NYp1)
     shy = (W, NXp1, NY)
 
+    sdt = plane_jnp_dtype(plane_dtype)
+    isz = jnp.dtype(sdt).itemsize
     lay = packed_layout(shx, shy, lane_mult)
-    G = (auto_block_nets(shx, shy, B, lane_mult)
+    G = (auto_block_nets(shx, shy, B, lane_mult, itemsize=isz)
          if block_nets is None else int(block_nets))
     G = max(1, min(G, B))
     NB = -(-B // G)
@@ -287,8 +338,10 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
     pyx, pyy = lay.pad_yx, lay.pad_yy
 
     def prep(part, shape, pad_y, fill):
-        return _bpad(fold_canvas(part.reshape((B,) + shape), pad_y),
-                     Bp - B, fill)
+        # quantize BEFORE padding so the ref carries the storage dtype
+        # (the pad fills are exactly representable in either dtype)
+        return _bpad(fold_canvas(part.reshape((B,) + shape).astype(sdt),
+                                 pad_y), Bp - B, fill)
 
     # inert batch-pad nets: d0 = +inf everywhere (no scan or turn can
     # improve an all-inf canvas), congestion/wenter/crit 0
@@ -320,18 +373,19 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
 
     f32 = jnp.float32
     rx, ry = lay.row_x, lay.row_y
-    out_shapes = [jax.ShapeDtypeStruct((Bp, rx), f32),
-                  jax.ShapeDtypeStruct((Bp, ry), f32),
+    out_shapes = [jax.ShapeDtypeStruct((Bp, rx), sdt),
+                  jax.ShapeDtypeStruct((Bp, ry), sdt),
                   jax.ShapeDtypeStruct((Bp, rx), jnp.int32),
                   jax.ShapeDtypeStruct((Bp, ry), jnp.int32),
-                  jax.ShapeDtypeStruct((Bp, rx), f32),
-                  jax.ShapeDtypeStruct((Bp, ry), f32),
+                  jax.ShapeDtypeStruct((Bp, rx), sdt),
+                  jax.ShapeDtypeStruct((Bp, ry), sdt),
                   jax.ShapeDtypeStruct((NB, 2), jnp.int32)]
     out_specs = [rowspec(rx), rowspec(ry), rowspec(rx), rowspec(ry),
                  rowspec(rx), rowspec(ry),
                  pl.BlockSpec((1, 2), lambda b: (b, 0))]
 
-    kern = functools.partial(_sweep_kernel, pg, nsweeps, G, pyx, pyy)
+    kern = functools.partial(_sweep_kernel, pg, nsweeps, G, pyx, pyy,
+                             plane_dtype)
     dx, dy, px, py, wx, wy, stats = pl.pallas_call(
         kern,
         grid=(NB,),
@@ -342,6 +396,10 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
         out_specs=out_specs,
         interpret=interpret,
     )(dx0, dy0, ccx, ccy, critb, wx0, wy0, *statics)
+
+    if sdt != f32:
+        # f32 flats regardless of storage dtype (planes_relax contract)
+        dx, dy, wx, wy = (a.astype(f32) for a in (dx, dy, wx, wy))
 
     def flat(ax, ay):
         ax = unfold_canvas(ax, shx, pyx)[:B]
@@ -358,7 +416,7 @@ def planes_relax_pallas(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
 
 def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
                        G: int, shx, shy, pad_yx: int, pad_yy: int,
-                       geo_meta, *refs):
+                       geo_meta, plane_dtype, *refs):
     """One grid step = a BLOCK of G nets' bb TILES, whole nsweeps loop
     in VMEM.  Geometry arrives pre-cropped per net (geom_cropped runs
     in XLA) and folded to [G, row] like the state; geo_meta carries
@@ -386,8 +444,10 @@ def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
     )
     dx = _load_packed(dx_ref, G, shx, pad_yx)
     dy = _load_packed(dy_ref, G, shy, pad_yy)
-    cc_x = _load_packed(ccx_ref, G, shx, pad_yx)
-    cc_y = _load_packed(ccy_ref, G, shy, pad_yy)
+    # congestion refs share the plane storage dtype; the sweep body
+    # computes in f32, so upcast once at load
+    cc_x = _load_packed(ccx_ref, G, shx, pad_yx).astype(jnp.float32)
+    cc_y = _load_packed(ccy_ref, G, shy, pad_yy).astype(jnp.float32)
     crit_c = crit_ref[:].reshape(G, 1, 1, 1)
     wx = _load_packed(wx_ref, G, shx, pad_yx)
     wy = _load_packed(wy_ref, G, shy, pad_yy)
@@ -400,7 +460,7 @@ def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
         return _sweep_once(gm, s, crit_c, cc_x, cc_y, costs)
 
     (dx, dy, predx, predy, wx, wy), stats = _run_relax(
-        body, (dx, dy, predx, predy, wx, wy), nsweeps)
+        body, (dx, dy, predx, predy, wx, wy), nsweeps, plane_dtype)
     _store_packed(odx_ref, dx, pad_yx)
     _store_packed(ody_ref, dy, pad_yy)
     _store_packed(opx_ref, predx, pad_yx)
@@ -412,12 +472,14 @@ def _crop_sweep_kernel(directional: bool, stride_x: int, nsweeps: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("nsweeps", "cnx", "cny", "interpret",
-                                    "block_nets", "lane_mult"))
+                                    "block_nets", "lane_mult",
+                                    "plane_dtype"))
 def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
                                 crit_c, wenter0, nsweeps: int, ox, oy,
                                 cnx: int, cny: int, interpret=None,
                                 block_nets=None,
-                                lane_mult: int = DEF_LANE_MULT):
+                                lane_mult: int = DEF_LANE_MULT,
+                                plane_dtype: str = "f32"):
     """Drop-in for planes.planes_relax_cropped, with the multi-sweep
     relaxation of a BLOCK of net TILES resident in VMEM — the
     composition of all three work/hardware-efficiency levers: per-net
@@ -432,13 +494,15 @@ def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
     one-net-per-step path for any block size."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    sdt = plane_jnp_dtype(plane_dtype)
+    isz = jnp.dtype(sdt).itemsize
     B = d0_flat.shape[0]
     W, NX, NYp1 = pg.shape_x
     shx = (W, cnx, cny + 1)
     shy = (W, cnx + 1, cny)
 
     lay = packed_layout(shx, shy, lane_mult)
-    G = (auto_block_nets(shx, shy, B, lane_mult)
+    G = (auto_block_nets(shx, shy, B, lane_mult, itemsize=isz)
          if block_nets is None else int(block_nets))
     G = max(1, min(G, B))
     NB = -(-B // G)
@@ -451,7 +515,9 @@ def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
         pg, d0_flat, cc_flat, wenter0, ox, oy, cnx, cny)
 
     def prep(a4, pad_y, fill):
-        return _bpad(fold_canvas(a4, pad_y), Bp - B, fill)
+        # downcast to the storage dtype before folding: HBM traffic and
+        # VMEM residency both pay the narrow width
+        return _bpad(fold_canvas(a4.astype(sdt), pad_y), Bp - B, fill)
 
     dx0 = prep(dx0, pyx, INF)
     dy0 = prep(dy0, pyy, INF)
@@ -488,19 +554,20 @@ def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
 
     f32 = jnp.float32
     rx, ry = lay.row_x, lay.row_y
-    out_shapes = [jax.ShapeDtypeStruct((Bp, rx), f32),
-                  jax.ShapeDtypeStruct((Bp, ry), f32),
+    out_shapes = [jax.ShapeDtypeStruct((Bp, rx), sdt),
+                  jax.ShapeDtypeStruct((Bp, ry), sdt),
                   jax.ShapeDtypeStruct((Bp, rx), jnp.int32),
                   jax.ShapeDtypeStruct((Bp, ry), jnp.int32),
-                  jax.ShapeDtypeStruct((Bp, rx), f32),
-                  jax.ShapeDtypeStruct((Bp, ry), f32),
+                  jax.ShapeDtypeStruct((Bp, rx), sdt),
+                  jax.ShapeDtypeStruct((Bp, ry), sdt),
                   jax.ShapeDtypeStruct((NB, 2), jnp.int32)]
     out_specs = [rowspec(rx), rowspec(ry), rowspec(rx), rowspec(ry),
                  rowspec(rx), rowspec(ry),
                  pl.BlockSpec((1, 2), lambda b: (b, 0))]
 
     kern = functools.partial(_crop_sweep_kernel, pg.directional, NYp1,
-                             nsweeps, G, shx, shy, pyx, pyy, geo_meta)
+                             nsweeps, G, shx, shy, pyx, pyy, geo_meta,
+                             plane_dtype)
     dx, dy, px, py, wx, wy, stats = pl.pallas_call(
         kern,
         grid=(NB,),
@@ -511,6 +578,11 @@ def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
         out_specs=out_specs,
         interpret=interpret,
     )(dx0, dy0, ccx, ccy, critb, wx0, wy0, *geo_in, inc)
+
+    if sdt != f32:
+        # scatter back into the f32 full canvases (planes_relax_cropped
+        # contract: f32 out regardless of storage dtype)
+        dx, dy, wx, wy = (a.astype(f32) for a in (dx, dy, wx, wy))
 
     def unfold6(a2, shape, pad_y):
         return unfold_canvas(a2, shape, pad_y)[:B]

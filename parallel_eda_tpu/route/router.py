@@ -203,6 +203,36 @@ class RouterOpts:
     # fault-injection sites are armed.  None = off (the default path
     # is byte-for-byte the non-resil dispatch)
     resil: Optional[object] = None
+    # Reduced-precision distance planes (planes.PLANE_DTYPES): "f32"
+    # is the bit-exact oracle; "bf16" stores and relaxes the distance/
+    # backtrack planes at half width (f32 accumulation inside every
+    # sweep — planes._run_relax), halving the bytes each relaxation
+    # sweep moves.  How bf16 results are USED depends on dtype_guard.
+    plane_dtype: str = "f32"
+    # Exactness guard for plane_dtype="bf16" (inert under f32):
+    #   "window": every window also runs a bf16 shadow replay on
+    #     non-donated state copies; the committed path stays the f32
+    #     oracle (QoR bit-exact BY CONSTRUCTION) and the shadow's
+    #     packed summary is compared at the window stall
+    #     (_dtype_band_ok).  A divergence beyond the declared ulp band
+    #     demotes dtype via the resil ladder ("dtype": bf16 -> f32),
+    #     counts route.kernel.dtype_demotions, and stops shadowing.
+    #   "route": same shadow compare, but only until the first clean
+    #     window — a per-route spot check instead of per-window.
+    #   "off": COMMIT the bf16 relaxation directly (the perf mode —
+    #     no oracle, no shadow cost; QoR parity is enforced by the
+    #     parity suite + the CI corpus wirelength gate instead).
+    dtype_guard: str = "window"
+    # Ragged fused dispatch: walk the whole crop-ladder of a window
+    # (every populated size-class rung) inside ONE device program
+    # (planes.route_window_planes_fused) instead of one dispatch per
+    # rung — same per-rung programs, same static shapes, bit-identical
+    # results; kills the per-dispatch overhead devprof flags on
+    # small-window variants.  The fused program is one more
+    # canonicalized variant key (dispatch cache / AOT library /
+    # watchdog chain / devprof all apply); under resil it degrades
+    # fused -> per_rung via the ladder "dispatch" dimension.
+    fused_dispatch: bool = False
 
 
 @dataclass
@@ -540,6 +570,41 @@ def _note_dispatch_variant(key) -> bool:
     return True
 
 
+# bf16 shadow-oracle acceptance band (RouterOpts.dtype_guard): the
+# fraction of per-net status words allowed to disagree with the f32
+# oracle, and the relative tolerance on the scalar congestion summary
+DTYPE_GUARD_STATUS_FRAC = 0.02
+DTYPE_GUARD_SCAL_RTOL = 0.05
+
+
+def _dtype_band_ok(status_f32, scal_f32, status_bf16, scal_bf16,
+                   status_frac: Optional[float] = None,
+                   scal_rtol: Optional[float] = None) -> bool:
+    """Band compare of a window's bf16 shadow summary against the
+    committed f32 oracle — the dtype-guard decision point (module
+    level so the parity suite can monkeypatch a forced violation).
+    The per-net status words may disagree on a small fraction of nets
+    (a half-ulp cost tie breaking the other way re-colors a net
+    without changing the negotiation outcome) and the scalar summary
+    (N_OVER, OVER_TOTAL, NROUTES, NEXEC, MAX_SPAN) must agree to a
+    relative tolerance with an absolute floor of 1.  The executed-trip
+    counters (S_EXEC, S_USEFUL) are excluded on purpose: reaching the
+    relaxation fixpoint a sweep earlier or later is a legitimate
+    reduced-precision outcome, not a divergence."""
+    if status_frac is None:
+        status_frac = DTYPE_GUARD_STATUS_FRAC
+    if scal_rtol is None:
+        scal_rtol = DTYPE_GUARD_SCAL_RTOL
+    st_a = np.asarray(status_f32)
+    st_b = np.asarray(status_bf16)
+    if st_a.size and float((st_a != st_b).mean()) > status_frac:
+        return False
+    a = np.asarray(scal_f32, dtype=np.float64)[:5]
+    b = np.asarray(scal_bf16, dtype=np.float64)[:5]
+    tol = np.maximum(1.0, scal_rtol * np.abs(a))
+    return bool((np.abs(a - b) <= tol).all())
+
+
 # how many overused rr-node ids each window's congestion record lists
 _CONGESTION_TOPK = 8
 
@@ -728,6 +793,41 @@ class Router:
                     *wp_args, **{**wp_kwargs, "use_pallas": False})
 
             rungs.append(Rung("xla", run_xla))
+        return resil_rt.guard.run(vkey, rungs)
+
+    def _guarded_dispatch_fused(self, resil_rt, vkey, f_args, f_kwargs,
+                                per_rung_fb):
+        """Fused-window dispatch under the resilience guard: AOT
+        library -> live jit of the fused ragged program -> the
+        sequential per-rung dispatch loop (the ladder's "dispatch"
+        dimension; bit-identical by construction — the fallback walks
+        the SAME planned rungs in the same threading order the fused
+        program unrolls on device).  Kernel-dimension descent
+        (pallas_g1/xla) is left to the per-rung chain: a window that
+        exhausts this chain retries per-rung, where _guarded_dispatch's
+        usual rungs apply."""
+        from ..resil.watchdog import Rung
+        from .planes import route_window_planes_fused
+        ladder = resil_rt.ladder
+        rungs = []
+        if (self._library is not None
+                and ladder.level("program") == 0):
+            def run_aot():
+                _note_dispatch_variant(vkey)
+                return self._library.dispatch(
+                    vkey, route_window_planes_fused, f_args, f_kwargs)
+
+            def evict_aot(reason):
+                self._library.evict(vkey, reason)
+
+            rungs.append(Rung("aot", run_aot, evict_aot))
+
+        def run_fused():
+            _note_dispatch_variant(vkey)
+            return route_window_planes_fused(*f_args, **f_kwargs)
+
+        rungs.append(Rung("fused", run_fused))
+        rungs.append(Rung("per_rung", per_rung_fb))
         return resil_rt.guard.run(vkey, rungs)
 
     @staticmethod
@@ -1009,17 +1109,29 @@ class Router:
             valid_plan[i, :len(b)] = True
         return sel_plan, valid_plan
 
-    def _plan_block_nets(self, tile, nnets: int, nsw: int) -> dict:
+    def _plan_block_nets(self, tile, nnets: int, nsw: int,
+                         plane_dtype: str = "f32") -> dict:
         """Kernel-layout plan for one dispatch (companion of
         _plan_groups): the SAME VMEM-budget math the packed Pallas
         wrappers apply (planes_pallas.auto_block_nets), so the
         route.kernel.* gauges report the block size / occupancy the
         kernel actually chose for this rung.  For the XLA program the
         row reports the unpadded one-net-per-step layout instead, with
-        the matching HBM traffic model (~15 canvas traversals/sweep vs
-        the VMEM-resident kernel's one load+store per relaxation)."""
-        from .planes_pallas import (auto_block_nets, packed_layout,
-                                    unpacked_lane_occupancy)
+        the matching HBM traffic model (per-sweep canvas traversals vs
+        the VMEM-resident kernel's one load+store per relaxation).
+        Both byte models are dtype-aware (planes_pallas.
+        packed_bytes_per_cell / xla_bytes_per_cell): bf16 planes halve
+        the streamed plane bytes while the int32 pred traffic stays
+        full-width, and the VMEM budget packs more nets per block
+        (auto_block_nets itemsize).  Nothing here is cached — a Router
+        reused across route() calls with a different plane_dtype
+        re-plans from scratch every dispatch."""
+        from .planes import plane_itemsize
+        from .planes_pallas import (auto_block_nets,
+                                    packed_bytes_per_cell,
+                                    packed_layout,
+                                    unpacked_lane_occupancy,
+                                    xla_bytes_per_cell)
 
         W, NX, NYp1 = self.pg.shape_x
         _, NXp1, NY = self.pg.shape_y
@@ -1030,19 +1142,22 @@ class Router:
             shx, shy = (W, NX, NYp1), (W, NXp1, NY)
         lay = packed_layout(shx, shy)
         n = max(1, int(nnets))
+        isz = plane_itemsize(plane_dtype)
         if self.use_pallas:
-            g = auto_block_nets(shx, shy, n)
+            g = auto_block_nets(shx, shy, n, itemsize=isz)
             plan = dict(variant="pallas_packed", block_nets=g,
                         lane_occupancy=round(lay.lane_occupancy(g), 4),
-                        bytes_per_sweep=int(2 * 6 * 4 * lay.padded_cells
-                                            * n / max(1, nsw)))
+                        bytes_per_sweep=int(
+                            packed_bytes_per_cell(isz)
+                            * lay.padded_cells * n / max(1, nsw)))
         else:
             plan = dict(variant="xla", block_nets=1,
                         lane_occupancy=round(
                             unpacked_lane_occupancy(shx, shy), 4),
-                        bytes_per_sweep=int(15 * 4 * lay.cells * n))
+                        bytes_per_sweep=int(
+                            xla_bytes_per_cell(isz) * lay.cells * n))
         plan.update(tile=(None if tile is None else list(tile)),
-                    nets=n, nsweeps=int(nsw))
+                    nets=n, nsweeps=int(nsw), plane_dtype=plane_dtype)
         return plan
 
     # escalating sync schedule: window sizes between host round trips
@@ -1083,7 +1198,9 @@ class Router:
         planned from a fully consumed summary — lag-0 — so results are
         bit-identical to pipeline=False, which drains each rung before
         any further host work (the --sync escape hatch)."""
-        from .planes import route_window_planes, unpack_window_status
+        from .planes import (PLANE_DTYPES, route_window_planes,
+                             route_window_planes_fused,
+                             unpack_window_status)
 
         opts = self.opts
         rr, dev = self.rr, self.dev
@@ -1219,6 +1336,29 @@ class Router:
         book = None           # deferred bookkeeping of the last window
         reg = get_metrics()
         tr = get_tracer()
+        # reduced-precision plane config (RouterOpts.plane_dtype /
+        # dtype_guard): guarded bf16 commits the f32 oracle every
+        # window and replays a bf16 shadow on non-donated state copies
+        # (QoR is bit-exact BY CONSTRUCTION; the shadow only validates
+        # the band); dtype_guard="off" commits bf16 directly.  A band
+        # violation demotes the route to f32 through the resil ladder's
+        # "dtype" dimension and counts route.kernel.dtype_demotions.
+        pd_req = str(opts.plane_dtype)
+        if pd_req not in PLANE_DTYPES:
+            raise ValueError(
+                f"plane_dtype must be one of {PLANE_DTYPES} "
+                f"(got {opts.plane_dtype!r})")
+        guard_mode = str(opts.dtype_guard)
+        if guard_mode not in ("window", "route", "off"):
+            raise ValueError(
+                "dtype_guard must be 'window', 'route', or 'off' "
+                f"(got {opts.dtype_guard!r})")
+        resil_rt = getattr(opts, "resil", None)
+        lad = resil_rt.ladder if resil_rt is not None else None
+        dtype_demoted = lad is not None and lad.level("dtype") > 0
+        dtype_validated = False     # guard="route" first-clean-window
+        reg.gauge("route.kernel.plane_dtype").set(
+            "bf16" if pd_req == "bf16" and not dtype_demoted else "f32")
         # cumulative pipeline accounting (drives the
         # route.pipeline.overlap_frac gauge): host seconds spent on
         # plan/stage/bookkeeping work, and the subset performed while
@@ -1302,13 +1442,39 @@ class Router:
             widen_d = (None if opts.sweep_budget_div <= 1
                        else self._staging.put("widen", budget_full))
 
-            def window_call(sub, tile, esc, pres_in, ri):
-                """One route_window_planes dispatch over the `sub`
-                subset of dirty nets (rung ``ri`` of this window's
-                dispatch ladder).  esc=False freezes the acc
-                escalation (the narrow call already applied it this
-                window; pres re-escalates identically in both so
-                iteration k sees the same pres)."""
+            # per-window dtype/dispatch resolution (re-checked every
+            # window: a mid-route demotion or a service-side ladder
+            # step takes effect at the next window boundary)
+            shadow_now = (pd_req == "bf16"
+                          and guard_mode in ("window", "route")
+                          and not dtype_demoted and not dtype_validated
+                          and (lad is None or lad.level("dtype") == 0))
+            pd_main = ("bf16" if pd_req == "bf16"
+                       and guard_mode == "off" and not dtype_demoted
+                       and (lad is None or lad.level("dtype") == 0)
+                       else "f32")
+            fused_now = (bool(opts.fused_dispatch) and self.mesh is None
+                         and (lad is None
+                              or lad.level("dispatch") == 0))
+            sh_stash = []
+            sh_state = None
+            if shadow_now:
+                # window-entry copies for the bf16 shadow replay:
+                # NON-donated (the main dispatch donates the
+                # originals), so the shadow can re-walk the same rungs
+                # after the committed window is in flight
+                sh_state = (occ + 0, acc + 0, paths + 0,
+                            sink_delay + 0, all_reached | False,
+                            bb + 0, crit_d + 0)
+
+            def plan_rung(sub, tile, ri):
+                """Host planning for one rung of this window's dispatch
+                ladder (the plan half of the old window_call): batch
+                plan, sweep budget, widen gate, kernel-layout plan, and
+                the staged device uploads.  Shared verbatim by the
+                per-rung and fused dispatch paths, so the fused program
+                walks EXACTLY the rungs the per-rung loop would have
+                dispatched."""
                 sel_p, valid_p = self._plan_groups(
                     sub, colors, nsinks_np, cx_np, cy_np, B, R)
                 ws = np.where(wide[sub], rr.grid.nx + 2, np.maximum(
@@ -1373,7 +1539,8 @@ class Router:
                          if doubling
                          else min(Smax, _pow2_at_least(
                              math.ceil(maxfan / grp_w) + 1)))
-                kplan = self._plan_block_nets(tile, len(sub), nsw)
+                kplan = self._plan_block_nets(tile, len(sub), nsw,
+                                              plane_dtype=pd_main)
                 # staged, hash-skipped plan uploads: identical plans
                 # (endgame windows redispatch the same few dirty nets)
                 # reuse the staged device buffer outright, and fresh
@@ -1381,24 +1548,29 @@ class Router:
                 # previous rung still executes
                 sel_d = self._staging.put(f"sel{ri}", sel_p)
                 valid_d = self._staging.put(f"valid{ri}", valid_p)
-                # canonical dispatch signature: everything jit traces
-                # as a static arg or shape.  New key = a fresh XLA
-                # compile (or persistent-cache load); known key = a jit
-                # cache hit
-                vkey = (tile, K, nsw, L, waves, grp_w, doubling,
-                        sel_p.shape[0], sel_p.shape[1], wok is None,
-                        self.use_pallas, self.mesh is not None,
-                        bool(sta_kw), R, Smax, N)
-                resil_rt = getattr(opts, "resil", None)
-                if resil_rt is None or resil_rt.guard is None:
-                    # resil dispatch notes per executed rung instead
-                    # (a degraded rung compiles a different program)
-                    _note_dispatch_variant(vkey)
-                wp_args = (
-                    self.pg, dev, occ, acc, paths, sink_delay,
-                    all_reached, bb, source_d, sinks_d, crit_d,
+                # ledger: filled batch slots, plan width, and real
+                # (non-pad) batch rows of this planned dispatch
+                return dict(tile=tile, nsw=nsw, waves=waves,
+                            grp_w=grp_w, doubling=doubling, wok=wok,
+                            sel_d=sel_d, valid_d=valid_d, kplan=kplan,
+                            sel_shape=sel_p.shape,
+                            ledger=(int(valid_p.sum()),
+                                    valid_p.shape[1],
+                                    int(valid_p.any(axis=1).sum())))
+
+            def rung_args(p, st, esc, pres_in):
+                """Positional route_window_planes args for planned rung
+                ``p`` against the state tuple ``st`` (occ, acc, paths,
+                sink_delay, all_reached, bb, crit).  esc=False freezes
+                the acc escalation (the first rung already applied it
+                this window; pres re-escalates identically in every
+                rung so iteration k sees the same pres)."""
+                occ2, acc2, paths2, sd2, ar2, bb2, crit2 = st
+                return (
+                    self.pg, dev, occ2, acc2, paths2, sd2, ar2, bb2,
+                    source_d, sinks_d, crit2,
                     *planes_tbl,
-                    sel_d, valid_d, full_bb,
+                    p["sel_d"], p["valid_d"], full_bb,
                     jnp.float32(pres_in),
                     jnp.float32(opts.pres_fac_mult),
                     jnp.float32(opts.max_pres_fac),
@@ -1406,17 +1578,49 @@ class Router:
                     jnp.int32(it_done),
                     jnp.int32(it_done + 1 if force_all_next
                               else opts.incremental_after),
-                    K, nsw, L, waves, grp_w,
-                    doubling, min(4096, N), 5, self.mesh)
-                wp_kwargs = dict(use_pallas=self.use_pallas,
-                                 crop_tile=tile, bb0_all=bb0_d,
-                                 widen_ok=wok, **sta_kw)
+                    K, p["nsw"], L, p["waves"], p["grp_w"],
+                    p["doubling"], min(4096, N), 5, self.mesh)
+
+            def rung_kwargs(p):
+                return dict(use_pallas=self.use_pallas,
+                            crop_tile=p["tile"], bb0_all=bb0_d,
+                            widen_ok=p["wok"], plane_dtype=pd_main,
+                            **sta_kw)
+
+            def window_call(p, esc, pres_in):
+                """One route_window_planes dispatch of planned rung
+                ``p`` (one rung of this window's dispatch ladder)."""
+                # canonical dispatch signature: everything jit traces
+                # as a static arg or shape.  New key = a fresh XLA
+                # compile (or persistent-cache load); known key = a jit
+                # cache hit
+                vkey = (p["tile"], K, p["nsw"], L, p["waves"],
+                        p["grp_w"], p["doubling"], p["sel_shape"][0],
+                        p["sel_shape"][1], p["wok"] is None,
+                        self.use_pallas, self.mesh is not None,
+                        bool(sta_kw), R, Smax, N, pd_main)
+                if resil_rt is None or resil_rt.guard is None:
+                    # resil dispatch notes per executed rung instead
+                    # (a degraded rung compiles a different program)
+                    _note_dispatch_variant(vkey)
+                wp_args = rung_args(
+                    p, (occ, acc, paths, sink_delay, all_reached, bb,
+                        crit_d), esc, pres_in)
+                wp_kwargs = rung_kwargs(p)
                 # device-truth profiling: avatarize the REAL call args
                 # BEFORE the dispatch donates them, so capture_all()
                 # can AOT-relower this exact variant later
                 get_devprof().note_variant(
-                    (tile, K, nsw, L, waves, grp_w), kplan,
+                    (p["tile"], K, p["nsw"], L, p["waves"],
+                     p["grp_w"]), p["kplan"],
                     route_window_planes, wp_args, wp_kwargs)
+                if shadow_now:
+                    # the bf16 shadow replays this exact dispatch on
+                    # its own state copies after the window commits
+                    # (only positions 2-7/10 — the donated state — are
+                    # swapped; plans/tables are reused, not donated)
+                    sh_stash.append((route_window_planes, wp_args,
+                                     wp_kwargs, vkey))
                 if resil_rt is not None and resil_rt.guard is not None:
                     # guarded dispatch: watchdog + retry/backoff over
                     # a chain of bit-identical rungs (AOT -> jit ->
@@ -1433,10 +1637,7 @@ class Router:
                         vkey, route_window_planes, wp_args, wp_kwargs)
                 else:
                     out = route_window_planes(*wp_args, **wp_kwargs)
-                # plan-shape ledger inputs: filled batch slots, plan
-                # width, and real (non-pad) batch rows of this dispatch
-                return out, (int(valid_p.sum()), valid_p.shape[1],
-                             int(valid_p.any(axis=1).sum())), kplan
+                return out
 
             t0 = time.time()
             tw0 = time.perf_counter()
@@ -1453,50 +1654,132 @@ class Router:
             # the pipeline's intra-window overlap
             retire.append(outs)     # keep donated-in refs alive
             outs = []
-            esc = True
             bucket_occ = []
             kplans = []
+            rung_scals = []
             comp_num = comp_den = 0
             plan_s = 0.0          # host plan/stage/dispatch, this window
             plan0_s = 0.0         # rung 0's share (nothing in flight yet)
             t_disp0 = None        # first dispatch return: exec start
             sync_block_s = 0.0    # --sync per-rung drain time
-            for ri, (sub0, tile) in enumerate(dispatch):
+            if fused_now:
+                # ---- fused ragged dispatch: plan EVERY populated rung
+                # first, then issue the whole ladder as ONE device
+                # program (planes.route_window_planes_fused) walking
+                # the static rung_desc table — bit-identical to the
+                # per-rung loop below (each rung keeps its own static
+                # shapes inside the one program; acc escalates on rung
+                # 0 only, mirroring esc=True-then-False) with one
+                # dispatch's overhead instead of one per rung ----
                 tp0 = time.perf_counter()
-                o, (nvalid, bg, grows), kplan = window_call(
-                    sub0, tile, esc, pres, ri)
-                esc = False
-                kplans.append(kplan)
-                # park the just-donated state refs before rebinding:
-                # dropping the last reference to a donated in-flight
-                # buffer blocks until its execution completes
+                plans = [plan_rung(sub0, tile, ri)
+                         for ri, (sub0, tile) in enumerate(dispatch)]
+                for p in plans:
+                    kplans.append(p["kplan"])
+                    nvalid, bg, grows = p["ledger"]
+                    if grows:
+                        bucket_occ.append(nvalid / (grows * bg))
+                        comp_num += grows * bg
+                        comp_den += grows * B
+                rung_desc = tuple(
+                    (p["tile"], p["nsw"], p["waves"], p["grp_w"],
+                     p["doubling"]) for p in plans)
+                widen_oks = (None
+                             if all(p["wok"] is None for p in plans)
+                             else tuple(p["wok"] for p in plans))
+                f_args = (
+                    self.pg, dev, occ, acc, paths, sink_delay,
+                    all_reached, bb, source_d, sinks_d, crit_d,
+                    *planes_tbl,
+                    tuple(p["sel_d"] for p in plans),
+                    tuple(p["valid_d"] for p in plans), full_bb,
+                    jnp.float32(pres),
+                    jnp.float32(opts.pres_fac_mult),
+                    jnp.float32(opts.max_pres_fac),
+                    jnp.float32(opts.acc_fac),
+                    jnp.int32(it_done),
+                    jnp.int32(it_done + 1 if force_all_next
+                              else opts.incremental_after),
+                    K, L)
+                f_kwargs = dict(
+                    rung_desc=rung_desc, topk=min(4096, N),
+                    n_colors=5, mesh=self.mesh,
+                    use_pallas=self.use_pallas, bb0_all=bb0_d,
+                    widen_oks=widen_oks, plane_dtype=pd_main,
+                    **sta_kw)
+                vkey = ("fused", rung_desc, K, L,
+                        tuple(p["sel_shape"] for p in plans),
+                        widen_oks is None, self.use_pallas,
+                        self.mesh is not None, bool(sta_kw),
+                        R, Smax, N, pd_main)
+                dom = max(kplans, key=lambda kp: kp.get("nets", 0))
+                get_devprof().note_variant(
+                    ("fused", rung_desc, K, L), dom,
+                    route_window_planes_fused, f_args, f_kwargs)
+                if shadow_now:
+                    sh_stash.append((route_window_planes_fused,
+                                     f_args, f_kwargs, vkey))
+
+                def run_per_rung_fb():
+                    # ladder "dispatch" fallback: the SAME planned
+                    # rungs, dispatched sequentially — equivalent
+                    # 24-tuple by construction (state threads rung to
+                    # rung exactly as the fused program unrolls it)
+                    st = (occ, acc, paths, sink_delay, all_reached,
+                          bb, crit_d)
+                    o2 = None
+                    scals = []
+                    for ri2, p2 in enumerate(plans):
+                        _note_dispatch_variant(
+                            vkey + ("per_rung", ri2))
+                        o2 = route_window_planes(
+                            *rung_args(p2, st, ri2 == 0, pres),
+                            **rung_kwargs(p2))
+                        st = o2[:6] + (o2[13],)
+                        scals.append(o2[22])
+                    return o2 + (jnp.stack(scals),)
+
+                if resil_rt is not None and resil_rt.guard is not None:
+                    out24 = self._guarded_dispatch_fused(
+                        resil_rt, vkey, f_args, f_kwargs,
+                        run_per_rung_fb)
+                elif self._library is not None:
+                    _note_dispatch_variant(vkey)
+                    out24 = self._library.dispatch(
+                        vkey, route_window_planes_fused, f_args,
+                        f_kwargs)
+                else:
+                    _note_dispatch_variant(vkey)
+                    out24 = route_window_planes_fused(*f_args,
+                                                      **f_kwargs)
+                o = tuple(out24[:23])
                 retire.append((occ, acc, paths, sink_delay,
                                all_reached, bb, crit_d))
                 occ, acc, paths, sink_delay, all_reached, bb = o[:6]
                 crit_d = o[13]
-                # start the packed summary copies now: by stall time
-                # they are already host-side (replaces the 13-array
-                # blocking jax.device_get of the pre-pipeline driver)
-                small = (o[21], o[22], o[14]) if analyzer is not None \
-                    else (o[21], o[22])
+                # the per-rung ledger rows come back as one stacked
+                # [n_rungs, SCAL_LEN] array (24th element)
+                rung_scals = [(out24[23][r],
+                               rung_desc[r][0] is not None)
+                              for r in range(len(rung_desc))]
+                small = (o[21], o[22], out24[23]) + (
+                    (o[14],) if analyzer is not None else ())
                 for a in small:
                     if hasattr(a, "copy_to_host_async"):
                         a.copy_to_host_async()
                 tp1 = time.perf_counter()
-                plan_s += tp1 - tp0
-                if ri == 0:
-                    plan0_s = tp1 - tp0
-                    t_disp0 = tp1
+                # everything is planned before the single dispatch, so
+                # the whole plan time is rung-0-equivalent (unoverlapped)
+                plan_s = plan0_s = tp1 - tp0
+                t_disp0 = tp1
                 if tr is not None:
                     tr.mark("route.pipeline.plan", tp0, tp1,
                             cat="route", stage="plan", window=widx,
-                            rung=ri, nets=len(sub0),
-                            tile=(None if tile is None else list(tile)))
+                            rung=0, nets=len(dirty), fused=True,
+                            rungs=len(dispatch))
                 if not pipelined:
-                    # --sync escape hatch: drain the rung before ANY
-                    # further host work, so plan spans can never
-                    # overlap device execution (trace_report --check
-                    # asserts exactly this)
+                    # --sync escape hatch: drain before ANY further
+                    # host work (trace_report --check contract)
                     # graftlint: ignore[pipeline-sync] — this IS the
                     # sanctioned --sync drain
                     jax.block_until_ready(o[21])
@@ -1505,15 +1788,104 @@ class Router:
                     reg.counter("route.pipeline.blocking_syncs").inc()
                     if tr is not None:
                         tr.mark("route.pipeline.exec", tp1, te1,
-                                cat="route", window=widx, rung=ri,
+                                cat="route", window=widx, rung=0,
                                 K=K, pipelined=False)
-                outs.append((o, tile))
-                if grows:
-                    bucket_occ.append(nvalid / (grows * bg))
-                    comp_num += grows * bg
-                    comp_den += grows * B
+                outs.append((o, dispatch[-1][1]))
+            else:
+                esc = True
+                for ri, (sub0, tile) in enumerate(dispatch):
+                    tp0 = time.perf_counter()
+                    p = plan_rung(sub0, tile, ri)
+                    o = window_call(p, esc, pres)
+                    esc = False
+                    kplans.append(p["kplan"])
+                    # park the just-donated state refs before
+                    # rebinding: dropping the last reference to a
+                    # donated in-flight buffer blocks until its
+                    # execution completes
+                    retire.append((occ, acc, paths, sink_delay,
+                                   all_reached, bb, crit_d))
+                    occ, acc, paths, sink_delay, all_reached, bb = \
+                        o[:6]
+                    crit_d = o[13]
+                    # start the packed summary copies now: by stall
+                    # time they are already host-side (replaces the
+                    # 13-array blocking jax.device_get of the
+                    # pre-pipeline driver)
+                    small = (o[21], o[22], o[14]) \
+                        if analyzer is not None else (o[21], o[22])
+                    for a in small:
+                        if hasattr(a, "copy_to_host_async"):
+                            a.copy_to_host_async()
+                    tp1 = time.perf_counter()
+                    plan_s += tp1 - tp0
+                    if ri == 0:
+                        plan0_s = tp1 - tp0
+                        t_disp0 = tp1
+                    if tr is not None:
+                        tr.mark("route.pipeline.plan", tp0, tp1,
+                                cat="route", stage="plan",
+                                window=widx, rung=ri, nets=len(sub0),
+                                tile=(None if tile is None
+                                      else list(tile)))
+                    if not pipelined:
+                        # --sync escape hatch: drain the rung before
+                        # ANY further host work, so plan spans can
+                        # never overlap device execution
+                        # (trace_report --check asserts exactly this)
+                        # graftlint: ignore[pipeline-sync] — this IS
+                        # the sanctioned --sync drain
+                        jax.block_until_ready(o[21])
+                        te1 = time.perf_counter()
+                        sync_block_s += te1 - tp1
+                        reg.counter(
+                            "route.pipeline.blocking_syncs").inc()
+                        if tr is not None:
+                            tr.mark("route.pipeline.exec", tp1, te1,
+                                    cat="route", window=widx, rung=ri,
+                                    K=K, pipelined=False)
+                    outs.append((o, tile))
+                    nvalid, bg, grows = p["ledger"]
+                    if grows:
+                        bucket_occ.append(nvalid / (grows * bg))
+                        comp_num += grows * bg
+                        comp_den += grows * B
+                rung_scals = [(o2[22], tc is not None)
+                              for o2, tc in outs]
             out, last_tile = outs[-1]
             force_all_next = False
+            # one relaxation dispatch per window when fused, one per
+            # populated crop rung otherwise (main committed path; the
+            # bf16 shadow's validation dispatches are not relaxation
+            # work and are counted by its own demotion telemetry)
+            reg.set_gauges({
+                "route.kernel.fused_rungs": len(dispatch),
+                "route.kernel.dispatches_per_window":
+                    1 if fused_now else len(dispatch),
+            })
+
+            # ---- bf16 shadow-oracle replay (dtype_guard): re-walk the
+            # SAME stashed dispatches on the non-donated window-entry
+            # copies with plane_dtype="bf16"; only the donated state
+            # positions (2-7, crit at 10) are swapped — the staged
+            # plans/tables are reused, the programs never donate them.
+            # Its packed summary is compared at the stall below ----
+            sh_out = None
+            if sh_stash:
+                s_st = sh_state
+                for s_fn, a_r, kw_r, s_vk in sh_stash:
+                    _note_dispatch_variant(s_vk + ("shadow_bf16",))
+                    s_out = s_fn(
+                        *(a_r[:2] + s_st[:6] + a_r[8:10]
+                          + (s_st[6],) + a_r[11:]),
+                        **{**kw_r, "plane_dtype": "bf16"})
+                    retire.append(s_st)
+                    s_st = tuple(s_out[:6]) + (s_out[13],)
+                    sh_out = s_out
+                retire.append(s_st)
+                for a in (sh_out[21], sh_out[22]):
+                    if hasattr(a, "copy_to_host_async"):
+                        a.copy_to_host_async()
 
             # ---- overlapped host stage: consume the PREVIOUS window's
             # summary (its bookkeeping was deferred to here, where this
@@ -1538,6 +1910,27 @@ class Router:
             dmax_hist = (np.asarray(out[14])  # graftlint: ignore[pipeline-sync]
                          if analyzer is not None
                          else None)
+            if sh_out is not None:
+                # the dtype-guard decision point: band-compare the
+                # bf16 shadow's packed summary against the committed
+                # f32 oracle (waiting here is the guard's cost — the
+                # shadow queued behind the committed window, so this
+                # read is usually already streamed)
+                s_status = np.asarray(sh_out[21])  # graftlint: ignore[pipeline-sync]
+                s_scal = np.asarray(sh_out[22])    # graftlint: ignore[pipeline-sync]
+                if _dtype_band_ok(status_np, scal_np, s_status,
+                                  s_scal):
+                    if guard_mode == "route":
+                        # per-route spot check: one clean window
+                        # validates the dtype for the rest of the route
+                        dtype_validated = True
+                else:
+                    dtype_demoted = True
+                    reg.counter("route.kernel.dtype_demotions").inc()
+                    reg.gauge("route.kernel.plane_dtype").set("f32")
+                    if lad is not None:
+                        lad.step("dtype", "bf16 window summary left "
+                                 "the declared ulp band")
             t_st1 = time.perf_counter()
             # everything donated into this window has now completed:
             # releasing the graveyard is a plain refcount drop
@@ -1621,7 +2014,7 @@ class Router:
                 over_total=over_total, ndirty=len(dirty), pres=pres,
                 cpd=cpd, t_wall0=t0, t_wall1=time.time(), tw0=tw0,
                 tw1=t_st1,
-                rung_scals=[(o[22], tc is not None) for o, tc in outs],
+                rung_scals=rung_scals,
                 bucket_occ=bucket_occ,
                 compaction=comp_num / max(1, comp_den), kplans=kplans,
                 colors_max=int(np.max(colors) + 1

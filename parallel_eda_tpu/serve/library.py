@@ -81,8 +81,14 @@ def _avatarize(tree):
 
 
 def _static_names(fn) -> Tuple[str, ...]:
-    """The static argnames of a jit-wrapped fn, from the shared
-    constant the decorator was built with."""
+    """The static argnames of a jit-wrapped fn.  The planes module
+    stamps ``_static_argnames`` on each window program (the fused
+    ragged-dispatch program has a different static set than the
+    per-rung one); fall back to the shared per-rung constant for
+    wrappers built before the stamp existed."""
+    names = getattr(fn, "_static_argnames", None)
+    if names is not None:
+        return tuple(names)
     from ..route.planes import WINDOW_STATIC_ARGNAMES
     return WINDOW_STATIC_ARGNAMES
 

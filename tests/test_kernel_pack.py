@@ -7,6 +7,12 @@ results must equal the legacy layout (block_nets=1, lane_mult=1)
 EXACTLY — same lowering, same shapes inside the body, same fold order.
 Covers odd batch remainders (inert pad nets), directional archs, and
 two crop-ladder rungs.  Interpret mode (no TPU in the test env).
+
+The second half extends the same bit-exactness contract to the PR-11
+kernel modes at full routing fidelity: guarded bf16 planes and the
+fused ragged window dispatch must reproduce the f32 per-rung route
+exactly, and a forced ulp-band violation must demote through the resil
+ladder's dtype dimension without changing QoR.
 """
 
 import jax.numpy as jnp
@@ -144,6 +150,23 @@ def test_kernel_bench_quick_check(tmp_path):
               if r["variant"].startswith("pallas_packed")]
     assert packed and all(r["lane_occupancy"] >= 0.5 for r in packed)
     assert all(r["bytes_per_sweep"] > 0 for r in doc["rows"])
+    # --quick benches f32 AND bf16 rows by default, and the bf16
+    # packed full-canvas byte model lands under the 0.6x-of-f32
+    # acceptance bar check_ledger enforces
+    bps = {r["plane_dtype"]: r["bytes_per_sweep"] * r["sweeps_executed"]
+           for r in doc["rows"] if r["variant"] == "pallas_packed"}
+    assert set(bps) == {"f32", "bf16"}
+    assert bps["bf16"] <= kb.BF16_PACKED_BYTES_RATIO_MAX * bps["f32"]
+    assert set(doc.get("dispatch_overhead", {})) == {"f32", "bf16"}
+    # a bf16 model that saves no bytes must fail the gate
+    inflated = json.loads(json.dumps(doc))
+    for r in inflated["rows"]:
+        if r["variant"] == "pallas_packed" \
+                and r["plane_dtype"] == "bf16":
+            r["bytes_per_sweep"] = bps["f32"]
+    bad = tmp_path / "bad_ratio.json"
+    bad.write_text(json.dumps(inflated))
+    assert kb.main(["--check", str(bad)]) != 0
     # a corrupted ledger must fail the gate
     doc["rows"][0].pop("roofline_fraction")
     bad = tmp_path / "bad.json"
@@ -166,3 +189,110 @@ def test_block_planning_model():
     # a rung too big for even one net still runs: G degrades to 1
     huge = (64, 512, 513)
     assert auto_block_nets(huge, (64, 513, 512), 64, 8) == 1
+
+
+# --------------------------------------------------------------------
+# Full-route parity for the PR-11 kernel modes: reduced-precision
+# planes (guarded) and the fused ragged window program are PERFORMANCE
+# knobs — occ/paths/wirelength must stay bit-identical to the f32
+# per-rung baseline on every arch family.  Flows and the f32 baseline
+# route are cached at module scope so each mode pays one route, not
+# three.
+
+_FLOWS: dict = {}
+_BASE: dict = {}
+
+
+def _flow(name):
+    from parallel_eda_tpu.flow import synth_flow
+    if name not in _FLOWS:
+        if name == "unidir":
+            _FLOWS[name] = synth_flow(
+                num_luts=12, num_inputs=5, num_outputs=5,
+                chan_width=14, seed=5,
+                arch=unidir_arch(chan_width=14, length=2))
+        elif name == "random7":
+            # a second generate_circuit draw: different seed, different
+            # topology — guards against a parity result that only holds
+            # for one routing instance
+            _FLOWS[name] = synth_flow(
+                num_luts=18, num_inputs=6, num_outputs=6,
+                chan_width=10, seed=7)
+        else:
+            _FLOWS[name] = synth_flow(
+                num_luts=15, num_inputs=6, num_outputs=6,
+                chan_width=10, seed=3)
+    return _FLOWS[name]
+
+
+def _baseline(name):
+    from parallel_eda_tpu.route import Router, RouterOpts
+    if name not in _BASE:
+        f = _flow(name)
+        res = Router(f.rr, RouterOpts(batch_size=32)).route(f.term)
+        assert res.success
+        _BASE[name] = res
+    return _BASE[name]
+
+
+def _assert_route_parity(name, kw):
+    from parallel_eda_tpu.route import Router, RouterOpts, check_route
+    f = _flow(name)
+    base = _baseline(name)
+    res = Router(f.rr, RouterOpts(batch_size=32, **kw)).route(f.term)
+    assert res.success, kw
+    assert np.array_equal(base.paths, res.paths), kw
+    assert np.array_equal(base.occ, res.occ), kw
+    assert base.wirelength == res.wirelength, kw
+    check_route(f.rr, f.term, res.paths, occ=res.occ)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(plane_dtype="bf16"),                        # per-window guard
+    dict(plane_dtype="bf16", dtype_guard="route"),   # first-clean-window
+    dict(fused_dispatch=True),                       # 1 dispatch/window
+    dict(plane_dtype="bf16", fused_dispatch=True),   # both at once
+], ids=["bf16_window", "bf16_route", "fused", "fused_bf16"])
+def test_route_parity_bench_arch(kw):
+    _assert_route_parity("bench", kw)
+
+
+@pytest.mark.parametrize("name", ["unidir", "random7"])
+def test_route_parity_other_archs(name):
+    """Directional wiring and a second random circuit, with both PR-11
+    knobs on simultaneously."""
+    _assert_route_parity(name,
+                         dict(plane_dtype="bf16", fused_dispatch=True))
+
+
+def test_forced_band_violation_demotes_dtype(monkeypatch):
+    """A bf16 window summary that leaves the declared ulp band must
+    demote the route to f32: the demotion counter fires once, the
+    plane_dtype gauge flips, the resil ladder's dtype dimension steps —
+    and QoR is still the f32 oracle's, because guarded mode never
+    committed a bf16 result in the first place."""
+    from parallel_eda_tpu.obs import (MetricsRegistry, get_metrics,
+                                      set_metrics)
+    from parallel_eda_tpu.resil import Resilience, ResilOpts
+    from parallel_eda_tpu.route import Router, RouterOpts
+    from parallel_eda_tpu.route import router as router_mod
+
+    monkeypatch.setattr(router_mod, "_dtype_band_ok",
+                        lambda *a, **k: False)
+    old = get_metrics()
+    reg = set_metrics(MetricsRegistry())
+    try:
+        rt = Resilience(ResilOpts())
+        f = _flow("bench")
+        res = Router(f.rr, RouterOpts(
+            batch_size=32, plane_dtype="bf16",
+            resil=rt)).route(f.term)
+        assert res.success
+        base = _baseline("bench")
+        assert np.array_equal(base.paths, res.paths)
+        assert base.wirelength == res.wirelength
+        assert reg.counter("route.kernel.dtype_demotions").value == 1
+        assert reg.gauge("route.kernel.plane_dtype").value == "f32"
+        assert rt.ladder.level("dtype") == 1
+    finally:
+        set_metrics(old)

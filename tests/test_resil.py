@@ -257,7 +257,9 @@ def test_ladder_levels_records_and_floor():
     lad = DegradationLadder()
     assert lad.snapshot() == {"kernel": "pallas_packed",
                               "pipeline": "pipelined",
-                              "program": "aot"}
+                              "program": "aot",
+                              "dtype": "bf16",
+                              "dispatch": "fused"}
     assert lad.step("pipeline", reason="poisoned dispatch")
     assert lad.level("pipeline") == 1
     assert lad.name("pipeline") == "sync"
@@ -267,7 +269,8 @@ def test_ladder_levels_records_and_floor():
     assert v["route.resil.level.pipeline"] == 1
     assert v["route.resil.level.kernel"] == 0
     assert v["route.resil.degradation_steps"] == 2
-    assert set(DIMS) == {"kernel", "pipeline", "program"}
+    assert set(DIMS) == {"kernel", "pipeline", "program", "dtype",
+                         "dispatch"}
 
 
 # ---- queue backoff vs deadline (fake clock; no jax) ----------------

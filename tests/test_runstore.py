@@ -50,6 +50,21 @@ def test_append_read_round_trip(tmp_path):
     assert rs.read_runs(runs, "absent") == []
 
 
+def test_plane_dtype_field_optional_and_v2_compatible():
+    """The dtype-era corpus field: absent means f32 (pre-PR-11 rows
+    stay valid), present means the row was routed with that plane
+    storage dtype — and it is string-typed like tenant/job_id."""
+    rs = _load()
+    legacy = _rec(rs)
+    assert "plane_dtype" not in legacy
+    assert rs.validate_record(legacy) == []
+    tagged = _rec(rs, plane_dtype="bf16")
+    assert tagged["plane_dtype"] == "bf16"
+    assert rs.validate_record(tagged) == []
+    bad = dict(tagged, plane_dtype=16)
+    assert rs.validate_record(bad)
+
+
 def test_scenario_sanitization():
     rs = _load()
     assert rs.sanitize_scenario("scale0_l60_w12") == "scale0_l60_w12"

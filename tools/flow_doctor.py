@@ -389,7 +389,11 @@ def check_resil(doc: dict) -> tuple:
     inj = g("injections")
     wdt = g("watchdog_timeouts")
     derr = g("dispatch_errors")
-    causes = inj + wdt + derr
+    # a bf16 window summary leaving the declared ulp band steps the
+    # dtype ladder dimension (router._dtype_band_ok) — a legitimate,
+    # counted cause for a degradation step
+    dtyped = vals.get("route.kernel.dtype_demotions") or 0
+    causes = inj + wdt + derr + dtyped
     q = g("quarantined_variants")
     ret = g("retries")
     cap = g("retry_cap")

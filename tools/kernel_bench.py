@@ -31,6 +31,20 @@ Off-TPU the Pallas kernels run in interpret mode: their wall times (and
 thus achieved GB/s) measure the interpreter, not the chip — the ledger
 marks interpret=true and the occupancy/bytes columns stay meaningful
 because they are layout models, not measurements.
+
+Two further dimensions (PR-11):
+
+- ``--plane_dtype {f32,bf16,both}`` benches every variant per plane
+  storage dtype; each row carries a ``plane_dtype`` column and its byte
+  model uses the dtype-aware formulas
+  (planes_pallas.packed_bytes_per_cell / xla_bytes_per_cell) — the
+  check enforces the bf16 packed full-canvas model at <= 0.6x f32.
+- the ``dispatch`` section measures the fixed per-dispatch cost (wall
+  of a minimal 1-sweep cropped dispatch, best-of-reps): the overhead
+  the router's fused ragged window program pays once per WINDOW instead
+  of once per populated crop rung.  The fused-vs-per-rung wall
+  comparison at full routing fidelity lives in bench.py
+  (--fused_dispatch); this column is the kernel-level decomposition.
 """
 
 from __future__ import annotations
@@ -56,7 +70,12 @@ PACKED_OCC_FLOOR = 0.5
 
 ROW_FIELDS = ("variant", "tile", "block_nets", "lane_occupancy",
               "bytes_per_sweep", "wall_ms", "sweeps_executed",
-              "achieved_gbps", "roofline_fraction")
+              "achieved_gbps", "roofline_fraction", "plane_dtype")
+
+# acceptance bar for the reduced-precision byte model: the bf16 packed
+# full-canvas variant must move at most this fraction of the f32 bytes
+# per sweep (2*(5*2+4)=28 vs 2*(5*4+4)=48 cells-bytes -> 0.583)
+BF16_PACKED_BYTES_RATIO_MAX = 0.6
 
 
 def log(msg: str) -> None:
@@ -118,7 +137,7 @@ def _time_best(fn, d0, reps: int):
 
 
 def _row(variant, tile, block_nets, occupancy, bytes_per_sweep,
-         wall_s, sweeps, peak_bw):
+         wall_s, sweeps, peak_bw, plane_dtype="f32"):
     achieved = bytes_per_sweep * sweeps / max(wall_s, 1e-12)
     return {
         "variant": variant,
@@ -130,6 +149,7 @@ def _row(variant, tile, block_nets, occupancy, bytes_per_sweep,
         "sweeps_executed": int(sweeps),
         "achieved_gbps": round(achieved / 1e9, 3),
         "roofline_fraction": round(achieved / peak_bw, 4),
+        "plane_dtype": plane_dtype,
     }
 
 
@@ -138,26 +158,34 @@ def run_bench(args) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from parallel_eda_tpu.route.planes import (planes_relax,
+    from parallel_eda_tpu.route.planes import (plane_itemsize,
+                                               planes_relax,
                                                planes_relax_cropped)
     from parallel_eda_tpu.route.planes_pallas import (
-        auto_block_nets, packed_layout, planes_relax_cropped_pallas,
-        planes_relax_pallas, unpacked_lane_occupancy)
+        auto_block_nets, packed_bytes_per_cell, packed_layout,
+        planes_relax_cropped_pallas, planes_relax_pallas,
+        unpacked_lane_occupancy, xla_bytes_per_cell)
 
     dev = jax.devices()[0]
     peak_bw = peak_hbm_bw(dev)
     interpret = dev.platform != "tpu"
     B, nsw, reps = args.batch, args.nsweeps, args.reps
+    dtypes = (("f32", "bf16") if args.plane_dtype == "both"
+              else (args.plane_dtype,))
     pg, d0, cc, crit, w0 = _instance(args.nx, args.ny, args.chan_width,
                                      B)
     log(f"device {dev.platform} (peak HBM {peak_bw / 1e9:.0f} GB/s, "
         f"pallas interpret={interpret}); canvas {args.nx}x{args.ny} "
-        f"W={args.chan_width} B={B}, {pg.ncells} cells/net")
+        f"W={args.chan_width} B={B}, {pg.ncells} cells/net, "
+        f"dtypes {'/'.join(dtypes)}")
 
     rows = []
+    dispatch = {}
 
-    def bench_shape(tile):
-        """All three variants at one shape (full canvas or a rung)."""
+    def bench_shape(tile, dt):
+        """All three variants at one shape (full canvas or a rung) for
+        one plane storage dtype."""
+        isz = plane_itemsize(dt)
         if tile is None:
             shx, shy = pg.shape_x, pg.shape_y
             sfx = ""
@@ -170,29 +198,34 @@ def run_bench(args) -> dict:
             ox = jnp.asarray(rng.integers(0, args.nx - t, B), jnp.int32)
             oy = jnp.asarray(rng.integers(0, args.ny - t, B), jnp.int32)
         lay = packed_layout(shx, shy)
+        # the planner is dtype-aware: halving the itemsize roughly
+        # doubles the nets one VMEM budget holds
         g_auto = (args.block if args.block else
-                  auto_block_nets(shx, shy, B))
+                  auto_block_nets(shx, shy, B, itemsize=isz))
 
         def make_fn(variant, g, lm):
             if tile is None:
                 if variant == "xla":
                     return jax.jit(lambda d: planes_relax(
-                        pg, d, cc, crit, w0, nsw)[-2:])
+                        pg, d, cc, crit, w0, nsw,
+                        plane_dtype=dt)[-2:])
                 return jax.jit(lambda d: planes_relax_pallas(
                     pg, d, cc, crit, w0, nsw, block_nets=g,
-                    lane_mult=lm)[-2:])
+                    lane_mult=lm, plane_dtype=dt)[-2:])
             if variant == "xla":
                 return jax.jit(lambda d: planes_relax_cropped(
                     pg, d, cc, crit, w0, nsw, ox, oy, tile,
-                    tile)[-2:])
+                    tile, plane_dtype=dt)[-2:])
             return jax.jit(lambda d: planes_relax_cropped_pallas(
                 pg, d, cc, crit, w0, nsw, ox, oy, tile, tile,
-                block_nets=g, lane_mult=lm)[-2:])
+                block_nets=g, lane_mult=lm, plane_dtype=dt)[-2:])
 
-        # models: the XLA lowering streams ~15 canvas read+writes per
-        # sweep through HBM; the Pallas kernels load+store the 6 state
+        # models: the XLA lowering streams ~15 canvas traversals per
+        # sweep through HBM (storage sets at the plane dtype, scan
+        # temporaries f32); the Pallas kernels load+store the state
         # canvases ONCE for the whole loop (amortized over the executed
-        # sweeps), padded columns included
+        # sweeps), padded columns included — both formulas live in
+        # planes_pallas so the router's planner and this bench agree
         for variant, g, lm in (("xla", 1, 1), ("pallas_g1", 1, 1),
                                ("pallas_packed", g_auto, None)):
             if lm is None:
@@ -202,36 +235,61 @@ def run_bench(args) -> dict:
             sweeps = max(1, int(stats[0]))
             if variant == "xla":
                 occ = unpacked_lane_occupancy(shx, shy)
-                bps = 15 * 4 * lay.cells * B
+                bps = xla_bytes_per_cell(isz) * lay.cells * B
             else:
                 vlay = packed_layout(shx, shy, lm)
                 occ = vlay.lane_occupancy(g)
-                bps = 2 * 6 * 4 * vlay.padded_cells * B / sweeps
+                bps = (packed_bytes_per_cell(isz) * vlay.padded_cells
+                       * B / sweeps)
             r = _row(variant + sfx, tile, g, occ, bps, wall, sweeps,
-                     peak_bw)
+                     peak_bw, plane_dtype=dt)
             rows.append(r)
-            log(f"{r['variant']:<22} G={g:<3} occ={occ:.3f} "
-                f"{r['wall_ms']:8.2f} ms  {r['achieved_gbps']:8.2f} "
-                f"GB/s ({r['roofline_fraction']:.1%} of roofline)")
+            log(f"[{dt:<4}] {r['variant']:<22} G={g:<3} "
+                f"occ={occ:.3f} {r['wall_ms']:8.2f} ms  "
+                f"{r['achieved_gbps']:8.2f} GB/s "
+                f"({r['roofline_fraction']:.1%} of roofline)")
 
-    bench_shape(None)
-    for t in args.crops:
-        if t >= min(args.nx, args.ny):
-            log(f"skipping crop rung {t}: tile exceeds the "
-                f"{args.nx}x{args.ny} canvas")
-            continue
-        bench_shape(t)
+    def bench_dispatch(dt):
+        """Fixed per-dispatch cost: best-of-reps wall of a MINIMAL
+        cropped dispatch (1 sweep, smallest rung).  One sweep of real
+        work rides along, so this is an upper bound on the launch +
+        retrace-free call overhead the fused window program saves per
+        eliminated rung dispatch."""
+        ts = [t for t in args.crops if t < min(args.nx, args.ny)]
+        t = min(ts) if ts else max(2, min(args.nx, args.ny) - 2)
+        rng = np.random.default_rng(3)
+        ox = jnp.asarray(rng.integers(0, args.nx - t, B), jnp.int32)
+        oy = jnp.asarray(rng.integers(0, args.ny - t, B), jnp.int32)
+        fn = jax.jit(lambda d: planes_relax_cropped(
+            pg, d, cc, crit, w0, 1, ox, oy, t, t,
+            plane_dtype=dt)[-2:])
+        wall, _ = _time_best(fn, d0, reps)
+        dispatch[dt] = {"tile": t, "wall_ms": round(wall * 1e3, 3)}
+        log(f"[{dt:<4}] dispatch overhead (1-sweep crop{t} xla): "
+            f"{wall * 1e3:.3f} ms upper bound")
+
+    for dt in dtypes:
+        bench_shape(None, dt)
+        for t in args.crops:
+            if t >= min(args.nx, args.ny):
+                log(f"skipping crop rung {t}: tile exceeds the "
+                    f"{args.nx}x{args.ny} canvas")
+                continue
+            bench_shape(t, dt)
+        bench_dispatch(dt)
 
     return {
         "config": {"nx": args.nx, "ny": args.ny,
                    "chan_width": args.chan_width, "batch": B,
                    "nsweeps": nsw, "reps": reps,
                    "crops": list(args.crops),
-                   "block": args.block or None},
+                   "block": args.block or None,
+                   "plane_dtype": args.plane_dtype},
         "device": {"platform": dev.platform,
                    "kind": getattr(dev, "device_kind", dev.platform),
                    "peak_hbm_gbps": round(peak_bw / 1e9, 1)},
         "interpret": interpret,
+        "dispatch_overhead": dispatch,
         "rows": rows,
     }
 
@@ -249,6 +307,8 @@ def check_ledger(doc) -> list:
     if not isinstance(rows, list) or not rows:
         return errs + ["'rows' missing/empty"]
     variants = set()
+    # packed full-canvas bytes model per dtype, for the bf16/f32 ratio
+    packed_bps = {}
     for i, r in enumerate(rows):
         if not isinstance(r, dict):
             errs.append(f"row {i}: not an object")
@@ -257,6 +317,14 @@ def check_ledger(doc) -> list:
             if f not in r:
                 errs.append(f"row {i}: missing '{f}'")
         variants.add(str(r.get("variant", "")))
+        pd = r.get("plane_dtype")
+        if pd not in ("f32", "bf16"):
+            errs.append(f"row {i}: bad plane_dtype {pd!r}")
+        elif str(r.get("variant", "")) == "pallas_packed":
+            # un-amortize (x executed sweeps): the ratio must compare
+            # the per-cell storage model, not each dtype's convergence
+            packed_bps[pd] = (r.get("bytes_per_sweep", 0)
+                              * max(1, r.get("sweeps_executed", 1)))
         occ = r.get("lane_occupancy")
         if not isinstance(occ, (int, float)) or not 0 < occ <= 1:
             errs.append(f"row {i}: bad lane_occupancy {occ!r}")
@@ -277,6 +345,18 @@ def check_ledger(doc) -> list:
     for need in ("xla", "pallas_g1", "pallas_packed"):
         if need not in variants:
             errs.append(f"no '{need}' full-canvas row")
+    # the reduced-precision acceptance bar: when both dtypes were
+    # benched, the bf16 packed full-canvas variant must MODEL at most
+    # BF16_PACKED_BYTES_RATIO_MAX of the f32 bytes per sweep (the
+    # whole point of halving the storage width)
+    if "f32" in packed_bps and "bf16" in packed_bps \
+            and packed_bps["f32"] > 0:
+        ratio = packed_bps["bf16"] / packed_bps["f32"]
+        if ratio > BF16_PACKED_BYTES_RATIO_MAX:
+            errs.append(
+                f"bf16 packed dispatch bytes are {ratio:.3f}x f32 — "
+                f"above the {BF16_PACKED_BYTES_RATIO_MAX} acceptance "
+                f"bar")
     return errs
 
 
@@ -291,6 +371,11 @@ def main(argv=None) -> int:
     ap.add_argument("--crops", default="6,8",
                     help="comma-separated crop-ladder rungs to bench "
                          "('' = full canvas only)")
+    ap.add_argument("--plane_dtype", default="both",
+                    choices=("f32", "bf16", "both"),
+                    help="plane storage dtype(s) to bench (default "
+                         "both; each row carries its dtype and the "
+                         "byte model follows the itemsize)")
     ap.add_argument("--block", type=int, default=0,
                     help="force the packed variants' block size "
                          "(default 0 = auto_block_nets per shape)")

@@ -24,7 +24,11 @@ ceiling overhead), and records the batch-plan shape per window:
     route.relax_wasted_frac    gauge    end-of-route wasted fraction
 
 Invariant checked: useful + wasted == total, occupancy and compaction
-in (0, 1], and the wasted fraction consistent with the counters.
+in (0, 1], and the wasted fraction consistent with the counters.  When
+the route.kernel dispatch-shape gauges are present, --check also
+enforces 1 <= dispatches_per_window <= fused_rungs (the fused window
+program must not issue more relaxation dispatches than it has
+populated crop rungs).
 """
 
 from __future__ import annotations
@@ -98,6 +102,26 @@ def validate(doc) -> list:
                 f"route.devcost.bytes_delta {bd!r} outside the "
                 f"1e±{DEVCOST_DELTA_BAND_LOG10} measured-vs-modeled "
                 f"sanity band")
+    # dispatch-shape invariant (PR-11): the fused window program issues
+    # exactly one relaxation dispatch per window, per-rung mode one per
+    # populated rung — so dispatches_per_window is in [1, fused_rungs]
+    dpw = values.get("route.kernel.dispatches_per_window")
+    if dpw is not None:
+        fr = values.get("route.kernel.fused_rungs")
+        if not (isinstance(dpw, (int, float)) and dpw >= 1):
+            errs.append(
+                f"route.kernel.dispatches_per_window not >= 1: {dpw!r}")
+        elif isinstance(fr, (int, float)) and dpw > fr:
+            errs.append(
+                f"route.kernel.dispatches_per_window {dpw} exceeds the "
+                f"populated-rung count route.kernel.fused_rungs {fr}")
+    dem = values.get("route.kernel.dtype_demotions")
+    if dem is not None and not (
+            isinstance(dem, (int, float)) and dem >= 0):
+        errs.append(f"bad route.kernel.dtype_demotions {dem!r}")
+    pd = values.get("route.kernel.plane_dtype")
+    if pd is not None and pd not in ("f32", "bf16"):
+        errs.append(f"bad route.kernel.plane_dtype {pd!r}")
     # per-snapshot monotonicity: counters never decrease along the run
     prev = (0, 0, 0)
     for i, s in enumerate(doc.get("snapshots", [])):
@@ -133,6 +157,14 @@ def summarize(doc) -> str:
     if comp is not None:
         lines.append(f"  plan compaction: {comp:.2f} of full width "
                      f"(last window)")
+    dpw = values.get("route.kernel.dispatches_per_window")
+    if dpw is not None:
+        fr = values.get("route.kernel.fused_rungs")
+        pd = values.get("route.kernel.plane_dtype")
+        lines.append(
+            f"  dispatch shape (last window): {int(dpw)} dispatch(es) "
+            f"for {int(fr) if fr is not None else '?'} populated "
+            f"rung(s), planes {pd or 'f32'}")
     ba = values.get("route.devcost.bytes_accessed")
     if ba is not None:
         bd = values.get("route.devcost.bytes_delta")
