@@ -24,7 +24,7 @@ from .faults import (
     FaultPlan,
 )
 from .checkpoint import CheckpointStore
-from .journal import Heartbeat, JournalStore
+from .journal import Heartbeat, JournalStore, LeaseStore
 from .watchdog import DispatchGuard, DispatchPoisonedError, Rung
 from .ladder import DegradationLadder
 
@@ -82,6 +82,7 @@ __all__ = [
     "CheckpointStore",
     "Heartbeat",
     "JournalStore",
+    "LeaseStore",
     "DispatchGuard",
     "DispatchPoisonedError",
     "Rung",

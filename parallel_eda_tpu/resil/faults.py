@@ -34,6 +34,9 @@ SITES = {
     "checkpoint.corrupt": "durable checkpoint file torn after write",
     "corpus.torn": "corrupt JSONL line injected into a corpus append",
     "backend.loss": "simulated backend/device loss at slice start",
+    "worker.kill": "fleet supervisor SIGKILLs a worker mid-slice",
+    "transport.drop": "transport listener drops a connection mid-request",
+    "lease.steal": "a held job lease is force-expired under its owner",
 }
 
 

@@ -49,12 +49,13 @@ Inbox layout::
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import statistics
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs.metrics import get_metrics
 from ..route.router import RouterOpts
@@ -66,9 +67,27 @@ SPEC_DIR = "specs"
 REJECT_NAME = "rejected.jsonl"
 HEARTBEAT_NAME = "heartbeat.json"
 DRAIN_NAME = "DRAIN"
+LEASE_DIR = "leases"
 
 #: journal states that survive a restart as live work
 _IN_FLIGHT = "in_flight"
+
+
+def heartbeat_name(worker: str = "") -> str:
+    """Solo daemons keep the historical ``heartbeat.json``; fleet
+    workers each beat their own ``heartbeat.<worker>.json`` so peers
+    (and the supervisor) can age every member independently."""
+    return f"heartbeat.{worker}.json" if worker else HEARTBEAT_NAME
+
+
+def preferred_worker(job_id: str, workers: List[str]) -> str:
+    """Stable job->worker assignment: every fleet member computes the
+    same answer from the sorted roster, so exactly one worker claims a
+    fresh submission and the rest hold it as takeover backup."""
+    roster = sorted(workers)
+    h = int.from_bytes(
+        hashlib.sha256(job_id.encode("utf-8")).digest()[:8], "big")
+    return roster[h % len(roster)]
 
 
 @dataclass
@@ -89,6 +108,12 @@ class DaemonOpts:
     exit_when_idle: int = 0        # idle cycles before exit (0 = never)
     torn_grace_polls: int = 2      # polls before a torn tail is skipped
     capacity_k: int = 8            # corpus rows in the capacity median
+    # ---- fleet membership (empty worker = historical solo daemon)
+    worker: str = ""               # this worker's fleet id
+    workers: Tuple[str, ...] = ()  # full roster (all members agree)
+    lease_ttl_s: float = 10.0      # job-lease expiry on the mono clock
+    foreign_grace_s: float = 3.0   # wait before claiming an unleased
+    #                                job assigned to a silent peer
 
 
 def submit_job(inbox_dir: str, spec: dict, tenant: str = "default",
@@ -309,7 +334,7 @@ class RouteDaemon:
                  clock: Callable[[], float] = time.monotonic,
                  wall: Callable[[], float] = time.time,
                  sleep: Callable[[float], None] = time.sleep):
-        from ..resil.journal import Heartbeat, JournalStore
+        from ..resil.journal import Heartbeat, JournalStore, LeaseStore
 
         self.service = service
         self.inbox_dir = inbox_dir
@@ -323,10 +348,30 @@ class RouteDaemon:
         self.reader = InboxReader(
             os.path.join(inbox_dir, SUBMIT_NAME),
             grace=self.opts.torn_grace_polls)
-        self.journal = JournalStore(os.path.join(inbox_dir, "journal"))
+        self.worker = self.opts.worker
+        # a fleet member keeps its OWN journal generation (two workers
+        # sharing one journal.json would clobber each other's truth)
+        # and its own heartbeat; leases are the only shared ownership
+        # state, and they are single-writer by construction
+        journal_dir = os.path.join(inbox_dir, "journal", self.worker) \
+            if self.worker else os.path.join(inbox_dir, "journal")
+        self.journal = JournalStore(journal_dir)
         self.heartbeat = Heartbeat(
-            os.path.join(inbox_dir, HEARTBEAT_NAME),
+            os.path.join(inbox_dir, heartbeat_name(self.worker)),
             interval_s=self.opts.heartbeat_s, clock=clock, wall=wall)
+        self.lease: Optional[LeaseStore] = None
+        if self.worker:
+            self.lease = LeaseStore(
+                os.path.join(inbox_dir, LEASE_DIR), self.worker,
+                ttl_s=self.opts.lease_ttl_s, clock=clock, wall=wall)
+            # fleet post-mortems must say WHO failed holding WHAT
+            service.diag_extra = lambda: {
+                "worker": self.worker,
+                "held_leases": self.lease.held()}
+        # foreign submissions (another worker's assignment) kept as
+        # takeover backup: job_id -> (first-seen clock, submission)
+        self._foreign: Dict[str, Tuple[float, dict]] = {}
+        self.failed_over_ids: List[str] = []
         lib = getattr(self.service.router, "_library", None)
         self.admission = AdmissionController(
             self.opts, runs_dir=service.runs_dir,
@@ -385,13 +430,22 @@ class RouteDaemon:
     def _reject(self, job_id: str, tenant: str, reason: dict) -> None:
         rec = {"job_id": job_id, "tenant": tenant, "state": "rejected",
                "reason": reason, "ts": self._wall()}
+        if self.worker:
+            rec["worker"] = self.worker
         self.rejected[job_id] = rec
         get_metrics().counter("route.daemon.rejected").inc()
         self._append_reject_line(rec)
+        if self.lease is not None:
+            # terminal release: a rejected job must not look like a
+            # dead peer's work a fleet member should take over
+            self.lease.release(job_id, state="rejected")
 
     def _append_reject_line(self, rec: dict) -> None:
         """One O_APPEND write: the submitter-visible terminal answer
-        for work the daemon refused or dropped."""
+        for work the daemon refused or dropped, attributed to the
+        fleet member that decided it."""
+        if self.worker:
+            rec = {**rec, "worker": self.worker}
         data = (json.dumps(rec, sort_keys=True, default=str)
                 + "\n").encode("utf-8")
         fd = os.open(os.path.join(self.inbox_dir, REJECT_NAME),
@@ -400,6 +454,59 @@ class RouteDaemon:
             os.write(fd, data)
         finally:
             os.close(fd)
+
+    def _fleet_claim(self, job_id: str) -> str:
+        """Fleet ownership decision for one submission:
+
+        * ``"run"`` — we hold (or just acquired/renewed) the lease;
+        * ``"failover"`` — we STOLE an expired peer lease: admit
+          unchecked and resume from the shared durable checkpoint;
+        * ``"defer"`` — a live peer owns it, or it is a peer's
+          assignment still inside its claim window; park it;
+        * ``"skip"`` — released terminal record: finished fleet-wide.
+        """
+        ls = self.lease
+        doc = ls.read(job_id)
+        if doc is not None:
+            if doc.get("released"):
+                return "skip"
+            if doc.get("worker") == self.worker:
+                ls.renew(job_id)
+                return "run"
+            if ls.expired(doc) and ls.steal(job_id):
+                return "failover"
+            return "defer"
+        roster = list(self.opts.workers) or [self.worker]
+        if preferred_worker(job_id, roster) != self.worker:
+            return "defer"
+        return "run" if ls.acquire(job_id) else "defer"
+
+    def _check_foreign(self) -> None:
+        """Takeover scan over parked peer-assigned submissions: a
+        released lease drops the parking, an expired one (dead peer)
+        is stolen via the normal claim path, and a job its assigned
+        worker never leased at all is taken over once the grace
+        elapses — no admitted submission can be orphaned by a worker
+        that died before claiming it."""
+        if self.lease is None or not self._foreign:
+            return
+        now = self._clock()
+        for job_id in sorted(self._foreign):
+            first, sub = self._foreign[job_id]
+            doc = self.lease.read(job_id)
+            if doc is None:
+                if now - first >= self.opts.foreign_grace_s \
+                        and self.lease.acquire(job_id):
+                    del self._foreign[job_id]
+                    self._admit_submission(sub)
+                continue
+            if doc.get("released"):
+                del self._foreign[job_id]
+                continue
+            if doc.get("worker") == self.worker \
+                    or self.lease.expired(doc):
+                del self._foreign[job_id]
+                self._admit_submission(sub)
 
     def _admit_submission(self, sub: dict, *,
                           recovery: bool = False) -> None:
@@ -412,6 +519,25 @@ class RouteDaemon:
         if self._known(job_id):
             get_metrics().counter("route.serve.jobs_deduped").inc()
             return
+        failover = False
+        if self.lease is not None:
+            claim = self._fleet_claim(job_id)
+            if claim == "defer":
+                self._foreign.setdefault(
+                    job_id, (self._clock(), dict(sub)))
+                return
+            if claim == "skip":
+                get_metrics().counter("route.serve.jobs_deduped").inc()
+                self._foreign.pop(job_id, None)
+                return
+            self._foreign.pop(job_id, None)
+            if claim == "failover":
+                # an expired peer lease was stolen: this is recovery
+                # of a peer's in-flight work, not a fresh admission —
+                # bypass admission control and resume from the shared
+                # durable checkpoint (bit-identical by construction)
+                failover = True
+                recovery = True
         ts = sub.get("ts")
         if isinstance(ts, (int, float)):
             get_metrics().gauge("route.daemon.inbox_lag_s").set(
@@ -464,6 +590,9 @@ class RouteDaemon:
             return
         job.scratch["nets"] = nets
         self._subs[job_id] = dict(sub)
+        if failover:
+            self.failed_over_ids.append(job_id)
+            get_metrics().counter("route.fleet.jobs_failed_over").inc()
         if recovery:
             self.recovered_ids.append(job_id)
             get_metrics().counter("route.daemon.recovered").inc()
@@ -527,12 +656,95 @@ class RouteDaemon:
                 continue
             self.shed_causes[j.job_id] = cause
             get_metrics().counter("route.daemon.shed").inc()
+            if self.lease is not None:
+                # the fleet shed it, the fleet won't retry it: release
+                # terminally so no peer mistakes it for dead-worker work
+                self.lease.release(j.job_id, state="shed")
             by_tenant[j.tenant] -= 1
             self._append_reject_line(
                 {"job_id": j.job_id, "tenant": j.tenant,
                  "state": "shed", "cause": cause, "ts": self._wall()})
             shed += 1
         return shed
+
+    # ------------------------------------------------- leases
+
+    def _lease_sweep(self) -> int:
+        """Per-cycle lease upkeep + fencing; returns jobs fenced off.
+
+        For every live local job: re-assert a missing record, renew a
+        healthy one, contest an expired one (the self-steal wins back
+        a chaos-forced lease when no peer gets there first), and FENCE
+        — evict the local copy — when a peer holds a live lease or a
+        released record exists: the job is someone else's now (or
+        finished), and running it here would double-execute.  Terminal
+        local jobs release their leases so peers never take over work
+        that already has an answer."""
+        ls = self.lease
+        if ls is None:
+            return 0
+        fenced = 0
+        for j in self.service.queue.jobs:
+            if j.state in (JobState.QUEUED, JobState.RUNNING):
+                doc = ls.read(j.job_id)
+                if doc is None:
+                    ls.acquire(j.job_id)
+                    continue
+                stolen = (doc.get("released")
+                          or (doc.get("worker") != self.worker
+                              and not ls.expired(doc)))
+                if not stolen and ls.expired(doc):
+                    # lapsed or chaos-forced: steal race, anyone's game
+                    stolen = not ls.steal(j.job_id)
+                if stolen:
+                    cause = {
+                        "code": "lease_stolen",
+                        "detail": f"lease for {j.job_id} is held "
+                                  f"elsewhere (or released); abandoning "
+                                  f"the local copy to avoid a double "
+                                  f"execution"}
+                    if self.service.queue.evict(
+                            j.job_id, JobState.SHED,
+                            error=cause["detail"]) is not None:
+                        self.shed_causes[j.job_id] = cause
+                        fenced += 1
+                elif doc.get("worker") == self.worker:
+                    ls.renew(j.job_id)
+            elif j.state in (JobState.DONE, JobState.FAILED,
+                             JobState.TIMEOUT):
+                doc = ls.read(j.job_id)
+                if doc is not None and not doc.get("released") \
+                        and doc.get("worker") == self.worker:
+                    ls.release(j.job_id, state=j.state.value)
+        return fenced
+
+    def _chaos_lease_steal(self) -> None:
+        """``lease.steal`` injection site: force-expire one held lease
+        under its owner.  Peers (or the owner itself, via the sweep's
+        steal race) must re-win it; the loser is fenced — exactly the
+        split-brain the lease protocol exists to resolve."""
+        rt = getattr(self.service, "resil", None)
+        if self.lease is None or rt is None \
+                or getattr(rt, "plan", None) is None:
+            return
+        held = self.lease.held()
+        if not held:
+            return
+        f = rt.plan.fire("lease.steal", detail=held[0])
+        if f is not None:
+            self.lease.force_expire(held[0])
+
+    def _runner(self, job: RouteJob):
+        """Queue runner: the service's, plus lease bookkeeping — a
+        finished job releases terminally, a preempted one renews so a
+        long multi-slice job never lapses mid-flight."""
+        verdict, value = self.service._runner(job)
+        if self.lease is not None:
+            if verdict == "done":
+                self.lease.release(job.job_id, state="done")
+            elif verdict == "preempted":
+                self.lease.renew(job.job_id)
+        return verdict, value
 
     # ------------------------------------------------- journal
 
@@ -605,11 +817,16 @@ class RouteDaemon:
         q = self.service.queue
         if self._drain_requested() and not self.service.draining:
             self.service.begin_drain()
-        self.heartbeat.beat(queue_depth=q.depth(), cycle=self.cycles,
-                            draining=self.service.draining)
+        hb_state = {"queue_depth": q.depth(), "cycle": self.cycles,
+                    "draining": self.service.draining}
+        if self.worker:
+            hb_state["worker"] = self.worker
+        self.heartbeat.beat(**hb_state)
         polled = self.reader.poll()
         for sub in polled:
             self._admit_submission(sub)
+        self._check_foreign()
+        self._chaos_lease_steal()
         self._shed_overload()
         if polled:
             # durability ordering: a job must be journaled as
@@ -618,15 +835,18 @@ class RouteDaemon:
             # the restart replays from the inbox instead of recovering
             self._flush_journal()
         before = sum(j.slices for j in q.jobs)
-        # one slice at a time with a beat between: a compile-heavy
-        # slice must not silence the heartbeat for a whole cycle
+        # one slice at a time with a beat (and a lease fence) between:
+        # a compile-heavy slice must not silence the heartbeat, and a
+        # stolen job must never get another local slice
         for _ in range(self.opts.slices_per_cycle):
+            self._lease_sweep()
             if q.depth() == 0:
                 break
-            q.run(self.service._runner, max_slices=1)
-            self.heartbeat.beat(queue_depth=q.depth(),
-                                cycle=self.cycles,
-                                draining=self.service.draining)
+            q.run(self._runner, max_slices=1)
+            hb_state["queue_depth"] = q.depth()
+            self.heartbeat.beat(**hb_state)
+        if q.depth() == 0:
+            self._lease_sweep()   # release freshly-terminal leases
         ran = sum(j.slices for j in q.jobs) - before
         m = get_metrics()
         m.gauge("route.daemon.uptime_s").set(
@@ -673,6 +893,9 @@ class RouteDaemon:
                    "preemptions": j.preemptions, "slices": j.slices,
                    "recovered": j.job_id in self.recovered_ids,
                    "failure_reason": j.failure_reason}
+            if self.worker:
+                row["worker"] = self.worker
+                row["failed_over"] = j.job_id in self.failed_over_ids
             if j.state is JobState.SHED:
                 row["shed_cause"] = self.shed_causes.get(j.job_id)
             if isinstance(j.result, dict):
@@ -685,9 +908,19 @@ class RouteDaemon:
                          "tenant": rec.get("tenant"),
                          "state": "rejected",
                          "reject_reason": rec.get("reason")})
+        fleet = None
+        if self.worker:
+            fleet = {"worker": self.worker,
+                     "roster": sorted(self.opts.workers or
+                                      (self.worker,)),
+                     "lease": self.lease.summary(),
+                     "failed_over": self.failed_over_ids,
+                     "pending_foreign": sorted(self._foreign),
+                     "metrics": m.values("route.fleet.")}
         return {
             "scenario": self.service.scenario,
             "jobs": jobs,
+            "fleet": fleet,
             "daemon": {
                 "inbox": {"dir": self.inbox_dir,
                           "consumed_bytes": self.reader.offset,
@@ -715,10 +948,14 @@ def build_daemon(inbox_dir: str, *, luts: int, chan_width: int = 16,
                  scenario: Optional[str] = None,
                  checkpoint_dir: Optional[str] = None,
                  opts: Optional[DaemonOpts] = None,
+                 fault_plan=None,
                  sync: bool = False) -> RouteDaemon:
     """Wire a production-shaped daemon: real synth flow on one device
     graph, resilience layer armed with durable checkpoints under the
-    inbox, service corpus rows feeding the admission estimator."""
+    inbox, service corpus rows feeding the admission estimator.
+    Fleet members share the inbox/checkpoints/leases/AOT library but
+    MUST NOT share a compile cache dir (see BENCHMARKS.md on the
+    cross-process compile-cache crash)."""
     from ..flow import synth_flow
     from ..resil import ResilOpts
 
@@ -731,6 +968,7 @@ def build_daemon(inbox_dir: str, *, luts: int, chan_width: int = 16,
         compile_cache_dir=compile_cache_dir or None,
         program_library_dir=library_dir or None)
     resil = ResilOpts(
+        fault_plan=fault_plan,
         checkpoint_dir=checkpoint_dir
         or os.path.join(inbox_dir, "ckpt"))
     service = RouteService(

@@ -76,6 +76,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="hard cycle cap (0 = none; tests/smoke)")
     r.add_argument("--summary", default="",
                    help="also write the summary JSON here (atomic)")
+    r.add_argument("--worker", default="",
+                   help="fleet member id; arms job leases, a "
+                   "per-worker journal + heartbeat, and failover")
+    r.add_argument("--workers", default="",
+                   help="comma-separated fleet roster (all members "
+                   "must agree; defaults to just --worker)")
+    r.add_argument("--lease_ttl_s", type=float, default=4.0,
+                   help="job-lease expiry on the monotonic clock — a "
+                   "dead worker's jobs fail over after this long")
+    r.add_argument("--foreign_grace_s", type=float, default=2.0,
+                   help="wait before claiming a job assigned to a "
+                   "peer that never leased it")
+    r.add_argument("--chaos", default="",
+                   help="seeded fault spec site:count[:horizon],... "
+                   "(worker-side sites, e.g. lease.steal)")
+    r.add_argument("--chaos_seed", type=int, default=0)
 
     s = sub.add_parser("submit", help="submit one synthetic job")
     s.add_argument("--inbox", required=True)
@@ -89,10 +105,57 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max_iterations", type=int, default=0)
     s.add_argument("--job_id", default="")
 
-    t = sub.add_parser("status", help="heartbeat + journal peek")
+    t = sub.add_parser("status", help="heartbeat + journal peek "
+                       "(aggregates every fleet member it finds)")
     t.add_argument("--inbox", required=True)
     t.add_argument("--stale_s", type=float, default=10.0,
                    help="exit 1 when the heartbeat is older than this")
+
+    f = sub.add_parser(
+        "fleet", help="spawn + supervise N replicated workers over "
+        "one inbox, with the network transport and the fleet chaos "
+        "sites (worker.kill, transport.drop, lease.steal)")
+    f.add_argument("--inbox", required=True)
+    f.add_argument("--workers", type=int, default=2, dest="n_workers")
+    f.add_argument("--luts", type=int, default=10)
+    f.add_argument("--chan_width", type=int, default=16)
+    f.add_argument("--slice", type=int, default=2, dest="slice_iters")
+    f.add_argument("--max_router_iterations", type=int, default=50)
+    f.add_argument("--library", default="",
+                   help="SHARED AOT program library (safe across "
+                   "workers; compile caches are per-worker)")
+    f.add_argument("--cache_base", default="",
+                   help="per-worker compile caches under "
+                   "<cache_base>/<worker> — never shared")
+    f.add_argument("--runs_dir", default="")
+    f.add_argument("--scenario", default="")
+    f.add_argument("--sync", action="store_true")
+    f.add_argument("--heartbeat_s", type=float, default=0.5)
+    f.add_argument("--poll_s", type=float, default=0.1)
+    f.add_argument("--lease_ttl_s", type=float, default=4.0)
+    f.add_argument("--foreign_grace_s", type=float, default=2.0)
+    f.add_argument("--exit_when_idle", type=int, default=0)
+    f.add_argument("--max_queue_depth", type=int, default=64,
+                   help="FLEET-total queue bound, partitioned evenly "
+                   "across workers")
+    f.add_argument("--chaos", default="",
+                   help="seeded fault spec; worker.kill and "
+                   "transport.drop run in the supervisor, the rest "
+                   "is forwarded to every worker")
+    f.add_argument("--chaos_seed", type=int, default=0)
+    f.add_argument("--no_transport", action="store_true")
+    f.add_argument("--host", default="127.0.0.1")
+    f.add_argument("--port", type=int, default=0,
+                   help="transport port (0 = ephemeral; the bound "
+                   "port is published to <inbox>/transport.json)")
+    f.add_argument("--expect_jobs", type=int, default=0,
+                   help="drain + exit once this many jobs hold "
+                   "released (terminal) leases")
+    f.add_argument("--tick_s", type=float, default=0.5)
+    f.add_argument("--timeout_s", type=float, default=600.0)
+    f.add_argument("--summary", default="",
+                   help="write the aggregated fleet summary here "
+                   "(atomic); flow_doctor --fleet-summary gates it")
     return p
 
 
@@ -103,6 +166,9 @@ def _cmd_run(args) -> int:
 
     t_start = time.perf_counter()
     get_metrics().enabled = True
+    worker = getattr(args, "worker", "")
+    roster = tuple(w for w in getattr(args, "workers", "").split(",")
+                   if w) or ((worker,) if worker else ())
     opts = DaemonOpts(
         poll_s=args.poll_s, heartbeat_s=args.heartbeat_s,
         slices_per_cycle=args.slices_per_cycle,
@@ -110,7 +176,14 @@ def _cmd_run(args) -> int:
         overload_factor=args.overload_factor,
         max_queue_depth=args.max_queue_depth,
         aging_rate=args.aging_rate,
-        exit_when_idle=args.exit_when_idle)
+        exit_when_idle=args.exit_when_idle,
+        worker=worker, workers=roster,
+        lease_ttl_s=args.lease_ttl_s,
+        foreign_grace_s=args.foreign_grace_s)
+    plan = None
+    if args.chaos:
+        from ..resil.faults import FaultPlan
+        plan = FaultPlan.parse(args.chaos_seed, args.chaos)
     daemon = build_daemon(
         args.inbox, luts=args.luts, chan_width=args.chan_width,
         batch_size=args.batch_size,
@@ -120,7 +193,7 @@ def _cmd_run(args) -> int:
         compile_cache_dir=args.compile_cache_dir or None,
         runs_dir=args.runs_dir or None,
         scenario=args.scenario or None,
-        opts=opts, sync=args.sync)
+        opts=opts, fault_plan=plan, sync=args.sync)
 
     def _graceful(signum, frame):
         daemon.request_stop()
@@ -163,16 +236,79 @@ def _cmd_submit(args) -> int:
 def _cmd_status(args) -> int:
     from ..resil.journal import Heartbeat, JournalStore
     from .daemon import HEARTBEAT_NAME
-    hb = Heartbeat.read(os.path.join(args.inbox, HEARTBEAT_NAME))
-    doc = JournalStore(os.path.join(args.inbox, "journal")).load()
+    # one inbox may host a solo daemon (heartbeat.json) or a fleet
+    # (heartbeat.<worker>.json each): aggregate whatever is there
+    hbs = {}
+    try:
+        names = sorted(os.listdir(args.inbox))
+    except OSError:
+        names = []
+    for name in names:
+        if name == HEARTBEAT_NAME:
+            key = "daemon"
+        elif name.startswith("heartbeat.") and name.endswith(".json"):
+            key = name[len("heartbeat."):-len(".json")]
+        else:
+            continue
+        hbs[key] = Heartbeat.read(os.path.join(args.inbox, name))
     states = {}
-    for e in (doc or {}).get("jobs", {}).values():
-        s = e.get("state", "?")
-        states[s] = states.get(s, 0) + 1
-    out = {"heartbeat": hb, "journal_jobs": states,
-           "alive": hb.get("age_s", float("inf")) <= args.stale_s}
+    jdir = os.path.join(args.inbox, "journal")
+    jdirs = [jdir] + [os.path.join(jdir, d)
+                      for d in (sorted(os.listdir(jdir))
+                                if os.path.isdir(jdir) else [])
+                      if os.path.isdir(os.path.join(jdir, d))]
+    for d in jdirs:
+        doc = JournalStore(d).load()
+        for e in (doc or {}).get("jobs", {}).values():
+            s = e.get("state", "?")
+            states[s] = states.get(s, 0) + 1
+    alive = {k: hb.get("age_s", float("inf")) <= args.stale_s
+             for k, hb in hbs.items()}
+    out = {"heartbeats": hbs, "journal_jobs": states,
+           "workers_alive": sum(alive.values()),
+           "alive": any(alive.values())}
+    # back-compat: the solo shape keeps its historical top-level key
+    if list(hbs) == ["daemon"]:
+        out["heartbeat"] = hbs["daemon"]
     print(json.dumps(out, default=str))
     return 0 if out["alive"] else 1
+
+
+def _cmd_fleet(args) -> int:
+    from ..obs.metrics import get_metrics
+    from .fleet import FleetOpts, FleetSupervisor
+
+    get_metrics().enabled = True
+    opts = FleetOpts(
+        n_workers=args.n_workers, luts=args.luts,
+        chan_width=args.chan_width, slice_iters=args.slice_iters,
+        max_router_iterations=args.max_router_iterations,
+        library_dir=args.library, cache_base=args.cache_base,
+        runs_dir=args.runs_dir, scenario=args.scenario,
+        sync=args.sync, heartbeat_s=args.heartbeat_s,
+        poll_s=args.poll_s, lease_ttl_s=args.lease_ttl_s,
+        foreign_grace_s=args.foreign_grace_s,
+        exit_when_idle=args.exit_when_idle,
+        max_queue_depth=args.max_queue_depth,
+        chaos_seed=args.chaos_seed, chaos=args.chaos,
+        transport=not args.no_transport,
+        host=args.host, port=args.port,
+        expect_jobs=args.expect_jobs, tick_s=args.tick_s)
+    sup = FleetSupervisor(args.inbox, opts)
+    summary = sup.run(timeout_s=args.timeout_s)
+    blob = json.dumps(summary, default=str)
+    if args.summary:
+        tmp = args.summary + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, args.summary)
+    print(blob)
+    bad = sup.timed_out or any(
+        r.get("state") in ("failed", "timeout")
+        for r in summary.get("jobs", []))
+    return 1 if bad else 0
 
 
 def main(argv=None) -> int:
@@ -182,6 +318,8 @@ def main(argv=None) -> int:
         return _cmd_run(args)
     if args.cmd == "submit":
         return _cmd_submit(args)
+    if args.cmd == "fleet":
+        return _cmd_fleet(args)
     return _cmd_status(args)
 
 

@@ -1,0 +1,360 @@
+"""Fleet supervisor: N replicated route workers over ONE durable inbox.
+
+``python -m parallel_eda_tpu daemon fleet`` spawns N worker daemons
+(`daemon run --worker wK --workers w0,..`) that share the inbox, the
+run corpus, the durable checkpoints, the lease directory, and the AOT
+program library — but NEVER a compile cache directory (each worker
+gets ``<cache_base>/<worker>``; see BENCHMARKS.md for the
+cross-process compile-cache crash verdict this fences).  The
+supervisor:
+
+* partitions admission capacity: each worker's ``max_queue_depth`` is
+  its share of the fleet total, so the fleet as a whole enforces the
+  same backlog bound a solo daemon would;
+* runs the network transport (``serve/transport.py``) over the shared
+  inbox, with the ``transport.drop`` chaos site armed;
+* monitors per-worker heartbeats (monotonic age) and publishes
+  ``route.fleet.workers_alive``;
+* owns the ``worker.kill`` chaos site: a scheduled firing SIGKILLs a
+  seeded-chosen live worker and does NOT respawn it — the surviving
+  peers must steal the victim's expired leases and finish its jobs
+  from the shared durable checkpoints (the failover the lease
+  protocol exists for);
+* detects completion by counting *released* lease records, then
+  touches ``DRAIN`` and waits the workers out;
+* aggregates every worker's summary (plus its own transport/fault/
+  lease state) into ONE fleet summary JSON, the artifact
+  ``flow_doctor --fleet-summary`` gates.
+
+Stdlib + repo-internal imports only; the workers are full daemons in
+their own processes, the supervisor never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..obs.metrics import get_metrics
+from ..resil.journal import Heartbeat, LeaseStore, _atomic_write_json
+from .daemon import LEASE_DIR, DRAIN_NAME, heartbeat_name
+from .transport import InboxHTTPServer
+
+#: chaos sites the supervisor itself owns; everything else in a fleet
+#: --chaos spec is forwarded to the workers
+SUPERVISOR_SITES = ("worker.kill", "transport.drop")
+
+
+def split_chaos(spec: str) -> tuple:
+    """Split a ``site:count[:horizon],...`` spec into the
+    supervisor-owned part and the worker-forwarded part."""
+    sup, wrk = [], []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        (sup if part.split(":")[0] in SUPERVISOR_SITES
+         else wrk).append(part)
+    return ",".join(sup), ",".join(wrk)
+
+
+@dataclass
+class FleetOpts:
+    """Supervisor knobs (the fleet CLI maps flags onto these)."""
+
+    n_workers: int = 2
+    luts: int = 10
+    chan_width: int = 16
+    slice_iters: int = 2
+    max_router_iterations: int = 50
+    library_dir: str = ""          # shared AOT program library
+    cache_base: str = ""           # per-worker compile caches live under
+    runs_dir: str = ""
+    scenario: str = ""
+    sync: bool = False
+    heartbeat_s: float = 0.5
+    poll_s: float = 0.1
+    lease_ttl_s: float = 4.0
+    foreign_grace_s: float = 2.0
+    exit_when_idle: int = 0        # workers: idle cycles before exit
+    max_queue_depth: int = 64      # FLEET total; partitioned per worker
+    chaos_seed: int = 0
+    chaos: str = ""                # full spec; split_chaos partitions it
+    transport: bool = True
+    host: str = "127.0.0.1"
+    port: int = 0                  # 0 = ephemeral
+    expect_jobs: int = 0           # stop once this many leases released
+    tick_s: float = 0.5            # monitor period
+    stale_after_s: float = 5.0     # heartbeat age that counts as dead
+    extra_worker_args: List[str] = field(default_factory=list)
+
+
+class FleetSupervisor:
+    def __init__(self, inbox_dir: str, opts: Optional[FleetOpts] = None):
+        from ..resil.faults import FaultPlan
+
+        self.inbox_dir = inbox_dir
+        self.opts = opts or FleetOpts()
+        os.makedirs(inbox_dir, exist_ok=True)
+        self.roster = [f"w{i}" for i in range(self.opts.n_workers)]
+        sup_spec, self.worker_chaos = split_chaos(self.opts.chaos)
+        self.plan = (FaultPlan.parse(self.opts.chaos_seed, sup_spec)
+                     if sup_spec else None)
+        self.server: Optional[InboxHTTPServer] = None
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self.killed: List[str] = []
+        self.exit_codes: Dict[str, Optional[int]] = {}
+        # read-only lease view (never acquires: a name outside the
+        # roster can't win any race by construction)
+        self.leases = LeaseStore(
+            os.path.join(inbox_dir, LEASE_DIR), "supervisor",
+            ttl_s=self.opts.lease_ttl_s)
+        self.timed_out = False
+        self._t0 = time.monotonic()
+
+    # ------------------------------------------------- spawning
+
+    def _summary_path(self, worker: str) -> str:
+        return os.path.join(self.inbox_dir, f"summary.{worker}.json")
+
+    def _worker_cmd(self, worker: str) -> List[str]:
+        o = self.opts
+        per_worker_depth = max(
+            1, o.max_queue_depth // max(1, o.n_workers))
+        cmd = [sys.executable, "-m", "parallel_eda_tpu", "daemon",
+               "run", "--inbox", self.inbox_dir,
+               "--worker", worker,
+               "--workers", ",".join(self.roster),
+               "--luts", str(o.luts),
+               "--chan_width", str(o.chan_width),
+               "--slice", str(o.slice_iters),
+               "--max_router_iterations", str(o.max_router_iterations),
+               "--heartbeat_s", str(o.heartbeat_s),
+               "--poll_s", str(o.poll_s),
+               "--lease_ttl_s", str(o.lease_ttl_s),
+               "--foreign_grace_s", str(o.foreign_grace_s),
+               "--max_queue_depth", str(per_worker_depth),
+               "--summary", self._summary_path(worker)]
+        if o.exit_when_idle:
+            cmd += ["--exit_when_idle", str(o.exit_when_idle)]
+        if o.library_dir:
+            cmd += ["--library", o.library_dir]
+        if o.cache_base:
+            # the segfault fence: one compile cache dir PER WORKER
+            cmd += ["--compile_cache_dir",
+                    os.path.join(o.cache_base, worker)]
+        if o.runs_dir:
+            cmd += ["--runs_dir", o.runs_dir]
+        if o.scenario:
+            cmd += ["--scenario", o.scenario]
+        if o.sync:
+            cmd += ["--sync"]
+        if self.worker_chaos:
+            cmd += ["--chaos", self.worker_chaos,
+                    "--chaos_seed", str(o.chaos_seed)]
+        return cmd + list(o.extra_worker_args)
+
+    def start(self) -> "FleetSupervisor":
+        m = get_metrics()
+        if self.opts.transport:
+            self.server = InboxHTTPServer(
+                self.inbox_dir, host=self.opts.host,
+                port=self.opts.port, plan=self.plan).start()
+            # publish the bound (possibly ephemeral) port durably so
+            # submitters can discover the fleet without racing stdout
+            _atomic_write_json(
+                os.path.join(self.inbox_dir, "transport.json"),
+                {"url": self.server.url})
+        for worker in self.roster:
+            self.procs[worker] = subprocess.Popen(
+                self._worker_cmd(worker),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            m.counter("route.fleet.workers_spawned").inc()
+        return self
+
+    # ------------------------------------------------- monitoring
+
+    def alive_workers(self) -> List[str]:
+        return [w for w, p in self.procs.items() if p.poll() is None]
+
+    def heartbeats(self) -> Dict[str, dict]:
+        out = {}
+        for w in self.roster:
+            hb = Heartbeat.read(
+                os.path.join(self.inbox_dir, heartbeat_name(w)))
+            out[w] = {"age_s": hb.get("age_s"),
+                      "age_src": hb.get("age_src"),
+                      "queue_depth": hb.get("queue_depth"),
+                      "beating": hb.get("age_s", float("inf"))
+                      <= self.opts.stale_after_s}
+        return out
+
+    def _chaos_worker_kill(self) -> None:
+        if self.plan is None:
+            return
+        alive = sorted(self.alive_workers())
+        if not alive:
+            return
+        # the site is ARMED only while an alive worker holds a live
+        # lease: a kill that cannot orphan in-flight work exercises
+        # nothing, so the seeded schedule counts armed ticks — the
+        # victim is always mid-job and the peers MUST fail over
+        holders = sorted({d.get("worker") for d in
+                          self.leases.scan().values()
+                          if not d.get("released")} & set(alive))
+        if not holders:
+            return
+        f = self.plan.fire("worker.kill", detail=",".join(holders))
+        if f is None:
+            return
+        victim = holders[f.seq % len(holders)]
+        # SIGKILL, not SIGTERM: no journal flush, no lease release —
+        # the worker dies the worst way it can, and it STAYS dead
+        # (no respawn): the peers must finish its work
+        try:
+            os.kill(self.procs[victim].pid, signal.SIGKILL)
+        except OSError:
+            return
+        self.procs[victim].wait()
+        self.killed.append(victim)
+        get_metrics().counter("route.fleet.workers_killed").inc()
+
+    def _released_jobs(self) -> List[str]:
+        return sorted(j for j, d in self.leases.scan().items()
+                      if d.get("released"))
+
+    def tick(self) -> dict:
+        """One monitor pass; returns the instantaneous fleet view."""
+        self._chaos_worker_kill()
+        alive = self.alive_workers()
+        get_metrics().gauge("route.fleet.workers_alive").set(len(alive))
+        released = self._released_jobs()
+        return {"alive": alive, "released": released,
+                "heartbeats": self.heartbeats()}
+
+    def run(self, timeout_s: float = 600.0) -> dict:
+        """Spawn (if needed), monitor to completion, aggregate.
+        Completion = ``expect_jobs`` released leases (when set), or
+        every worker exited on its own."""
+        if not self.procs:
+            self.start()
+        o = self.opts
+        deadline = time.monotonic() + timeout_s
+        t_serve0 = time.monotonic()
+        try:
+            while True:
+                view = self.tick()
+                if o.expect_jobs \
+                        and len(view["released"]) >= o.expect_jobs:
+                    break
+                if not view["alive"]:
+                    break
+                if time.monotonic() > deadline:
+                    self.timed_out = True
+                    break
+                time.sleep(o.tick_s)
+            self._drain_and_wait(deadline)
+        finally:
+            self._reap()
+            if self.server is not None:
+                self.server.stop()
+        return self.summary(serve_wall_s=time.monotonic() - t_serve0)
+
+    def _drain_and_wait(self, deadline: float) -> None:
+        drain = os.path.join(self.inbox_dir, DRAIN_NAME)
+        with open(drain + ".tmp", "w") as f:
+            f.write("fleet drain\n")
+        os.replace(drain + ".tmp", drain)
+        while self.alive_workers():
+            if time.monotonic() > deadline:
+                self.timed_out = True
+                break
+            time.sleep(min(0.2, self.opts.tick_s))
+
+    def _reap(self) -> None:
+        for w, p in self.procs.items():
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            self.exit_codes[w] = p.returncode
+
+    # ------------------------------------------------- aggregation
+
+    def _worker_summary(self, worker: str) -> Optional[dict]:
+        try:
+            with open(self._summary_path(worker)) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def summary(self, serve_wall_s: float = 0.0) -> dict:
+        """The ``flow_doctor --fleet-summary`` artifact: merged job
+        rows (worker-attributed), fleet-wide route.fleet.* metrics
+        (workers' counters summed + the supervisor's own), the lease
+        table, transport counters, and the fault log."""
+        jobs: List[dict] = []
+        merged: Dict[str, float] = dict(
+            get_metrics().values("route.fleet."))
+        per_worker: Dict[str, dict] = {}
+        for w in self.roster:
+            doc = self._worker_summary(w)
+            row = {"worker": w,
+                   "pid": self.procs[w].pid if w in self.procs else None,
+                   "killed": w in self.killed,
+                   "exit_code": self.exit_codes.get(w),
+                   "wrote_summary": doc is not None}
+            per_worker[w] = row
+            if doc is None:
+                continue
+            jobs.extend(doc.get("jobs") or [])
+            fleet = doc.get("fleet") or {}
+            for k, v in (fleet.get("metrics") or {}).items():
+                if isinstance(v, (int, float)):
+                    merged[k] = merged.get(k, 0) + v
+        # a gauge is a point-in-time reading, not summable: report the
+        # supervisor's own final observation
+        merged["route.fleet.workers_alive"] = len(self.alive_workers())
+        leases = {j: {"worker": d.get("worker"),
+                      "state": d.get("state"),
+                      "generation": d.get("generation"),
+                      "released": bool(d.get("released"))}
+                  for j, d in self.leases.scan().items()}
+        nets = sum(int(r.get("nets") or 0) for r in jobs
+                   if r.get("state") == "done")
+        return {
+            "scenario": self.opts.scenario or "fleet",
+            "jobs": jobs,
+            "fleet": {
+                "inbox": self.inbox_dir,
+                "roster": self.roster,
+                "workers": per_worker,
+                "killed": self.killed,
+                "expect_jobs": self.opts.expect_jobs,
+                "timed_out": self.timed_out,
+                "leases": leases,
+                "transport": (self.server.summary()
+                              if self.server is not None else None),
+                "faults": (self.plan.summary()
+                           if self.plan is not None else None),
+                "worker_chaos": self.worker_chaos,
+                "metrics": merged,
+                "aggregate": {
+                    "nets": nets,
+                    "wall_s": round(serve_wall_s, 3),
+                    "nets_per_s": round(
+                        nets / max(serve_wall_s, 1e-9), 3),
+                },
+            },
+        }

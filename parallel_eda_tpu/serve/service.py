@@ -20,7 +20,7 @@ import json
 import os
 import time
 from dataclasses import dataclass, field, replace
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -76,6 +76,10 @@ class RouteService:
         self.draining = False
         self._t_init = time.perf_counter()
         self._first_slice_s: Optional[float] = None
+        # host-context hook: the daemon/fleet layer injects a callable
+        # returning attribution fields (worker id, held leases) that
+        # every diagnostic bundle must carry
+        self.diag_extra: Optional[Callable[[], dict]] = None
 
     # ------------------------------------------------------- admit
 
@@ -306,6 +310,11 @@ class RouteService:
             "checkpoint": ck_meta,
             "resil_metrics": get_metrics().values("route.resil."),
         }
+        if callable(self.diag_extra):
+            # fleet attribution: which worker buried this job, holding
+            # which leases — without it a fleet post-mortem is
+            # anonymous
+            bundle.update(self.diag_extra())
         path = os.path.join(diag_dir, f"{job.job_id}.diag.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:

@@ -462,8 +462,10 @@ def test_heartbeat_interval_and_max_gap(tmp_path):
     assert hb.beat(cycle=4)
     assert hb.beats == 3
     assert hb.max_gap_s == pytest.approx(6.8)
-    doc = Heartbeat.read(hb.path, wall=lambda: 500.0 + clk.t)
+    doc = Heartbeat.read(hb.path, wall=lambda: 500.0 + clk.t,
+                         mono=lambda: clk.t)
     assert doc["age_s"] == pytest.approx(0.0)
+    assert doc["age_src"] == "mono"
     assert doc["cycle"] == 4
     missing = Heartbeat.read(str(tmp_path / "nope.json"))
     assert missing["age_s"] == float("inf")

@@ -47,6 +47,13 @@ Checks, each skipped (with a note) when its artifact is not given:
            band, and recovered jobs must be backed by a journal that
            actually wrote — a daemon that drops work silently or
            claims recovery without durable state is UNHEALTHY
+  fleet    (--fleet-summary FILE) the fleet supervisor's aggregate
+           summary (daemon fleet --summary, serve/fleet.py): failover
+           implies a measured lease expiry, transport retries stay
+           inside the client's declared budget, every lease is
+           released at shutdown (no orphaned work), no job completes
+           twice across workers, and every job row names its worker —
+           a fleet that fakes failover or leaks work is UNHEALTHY
   lint     (--lint [--lint-root DIR]) the graft-lint static rule set
            (parallel_eda_tpu/analysis): donation safety, jit-signature
            drift, determinism, durable-write atomicity, metric-name
@@ -446,9 +453,11 @@ def check_daemon(doc: dict) -> tuple:
       * a REJECTED submission without a machine-readable reason
         ({"code": ...}) — the admission controller must never ghost a
         client;
-      * a SHED job without an overload cause, or any shedding while
-        the daemon never recorded an overloaded cycle — eviction must
-        be traceable to measured overload, not mood;
+      * a SHED job without an overload cause, or any OVERLOAD shedding
+        while the daemon never recorded an overloaded cycle — eviction
+        must be traceable to measured overload, not mood (the
+        "lease_stolen" cause is exempt: that is fleet lease fencing,
+        a correctness eviction, not load shedding);
       * a heartbeat gap beyond HEARTBEAT_GAP_FACTOR x the declared
         interval (or an uptime with no beats at all) — the daemon
         claimed liveness it did not have;
@@ -479,10 +488,16 @@ def check_daemon(doc: dict) -> tuple:
         if not (isinstance(cause, dict) and cause.get("code")):
             errs.append(f"daemon: job {j.get('job_id')} shed without "
                         f"an overload cause (got {cause!r})")
-    if shed and not g("overloaded_cycles"):
-        errs.append(f"daemon: {len(shed)} job(s) shed but the daemon "
-                    f"never recorded an overloaded cycle — load was "
-                    f"dropped without measured overload")
+    # lease fencing (a peer holds the live lease: "lease_stolen") is a
+    # correctness eviction, not load shedding — it needs no measured
+    # overload behind it
+    overload_shed = [j for j in shed
+                     if (j.get("shed_cause") or {}).get("code")
+                     != "lease_stolen"]
+    if overload_shed and not g("overloaded_cycles"):
+        errs.append(f"daemon: {len(overload_shed)} job(s) shed but "
+                    f"the daemon never recorded an overloaded cycle — "
+                    f"load was dropped without measured overload")
     hb = d.get("heartbeat") or {}
     interval = hb.get("interval_s")
     beats = hb.get("beats", 0)
@@ -517,6 +532,104 @@ def check_daemon(doc: dict) -> tuple:
                  f"admitted={g('admitted')} rejected={len(rejected)} "
                  f"shed={len(shed)} recovered={n_rec} "
                  f"torn_inbox_lines={inbox.get('torn_lines', 0)}")
+    return errs, notes
+
+
+def check_fleet(doc: dict) -> tuple:
+    """Fleet rule set over a fleet summary JSON (``daemon fleet
+    --summary``, serve/fleet.py).  Returns (errors, notes).  The rules
+    catch a fleet that fakes failover or leaks work:
+
+      * failover implies lease expiry — a job cannot "fail over" to a
+        peer unless its old lease measurably expired first
+        (jobs_failed_over > 0 requires leases_expired > 0);
+      * transport retries bounded — the server must never observe a
+        client attempt number above the client's own declared cap, and
+        total retries must fit inside drops x (cap - 1): retry storms
+        are a bug, not resilience;
+      * no orphaned leases — when the fleet is done, every lease
+        record is terminal (released); a held lease with no worker
+        behind it is leaked work;
+      * no job finishes twice — exactly-once execution is the entire
+        point of the lease protocol;
+      * worker attribution — every job row names the worker that
+        produced it, or the failover story is unauditable.
+    """
+    errs, notes = [], []
+    fl = doc.get("fleet")
+    if not isinstance(fl, dict):
+        return (["fleet-summary: no fleet section (not a fleet "
+                 "summary JSON?)"], notes)
+    vals = fl.get("metrics") or {}
+
+    def g(k):
+        return vals.get("route.fleet." + k) or 0
+
+    if fl.get("timed_out"):
+        errs.append("fleet: the supervisor timed out before the fleet "
+                    "finished — completion was never observed")
+
+    # -- failover implies lease expiry
+    if g("jobs_failed_over") and not g("leases_expired"):
+        errs.append(f"fleet: {g('jobs_failed_over')} job(s) claim "
+                    f"failover but no lease ever expired — a peer "
+                    f"took work from a live owner")
+
+    # -- transport retries bounded
+    tr = fl.get("transport")
+    if isinstance(tr, dict):
+        cap = tr.get("retry_cap_seen") or 0
+        seen = tr.get("max_attempt_seen") or 0
+        drops = tr.get("drops") or 0
+        retries = tr.get("retries") or 0
+        if cap and seen > cap:
+            errs.append(f"fleet: transport observed attempt #{seen} "
+                        f"above the client's declared cap of {cap} — "
+                        f"the retry budget is a lie")
+        if drops and cap and retries > drops * max(cap - 1, 1):
+            errs.append(f"fleet: {retries} transport retries exceed "
+                        f"the budget for {drops} drop(s) at cap {cap} "
+                        f"({drops * max(cap - 1, 1)}) — retry storm")
+        if drops and not retries:
+            errs.append(f"fleet: transport dropped {drops} "
+                        f"request(s) but no client ever retried — "
+                        f"submissions were silently lost")
+
+    # -- no orphaned leases
+    leases = fl.get("leases") or {}
+    orphans = sorted(j for j, d in leases.items()
+                     if isinstance(d, dict) and not d.get("released"))
+    if orphans:
+        errs.append(f"fleet: {len(orphans)} unreleased lease(s) after "
+                    f"shutdown ({', '.join(orphans[:5])}"
+                    f"{', ...' if len(orphans) > 5 else ''}) — "
+                    f"leaked work nobody will finish")
+
+    # -- no job finishes twice; worker attribution
+    jobs = doc.get("jobs") or []
+    done_by: dict = {}
+    for j in jobs:
+        jid = j.get("job_id")
+        if j.get("state") == "done":
+            done_by.setdefault(jid, []).append(j.get("worker"))
+        if not j.get("worker"):
+            errs.append(f"fleet: job {jid} row carries no worker "
+                        f"attribution — failover is unauditable")
+    for jid, workers in sorted(done_by.items()):
+        if len(workers) > 1:
+            errs.append(f"fleet: job {jid} finished {len(workers)} "
+                        f"times (workers {', '.join(map(str, workers))})"
+                        f" — the lease protocol failed exactly-once")
+
+    killed = fl.get("killed") or []
+    agg = fl.get("aggregate") or {}
+    notes.append(f"fleet: workers={len(fl.get('roster') or [])} "
+                 f"killed={len(killed)} jobs={len(jobs)} "
+                 f"done={len(done_by)} "
+                 f"failed_over={int(g('jobs_failed_over'))} "
+                 f"lease_steals={int(g('lease_steals'))} "
+                 f"transport_retries={int(g('transport_retries'))} "
+                 f"nets_per_s={agg.get('nets_per_s')}")
     return errs, notes
 
 
@@ -590,6 +703,12 @@ def main(argv=None) -> int:
                          "daemon rule set (rejection reasons, shed "
                          "causes vs measured overload, heartbeat "
                          "gaps, recovery provenance)")
+    ap.add_argument("--fleet-summary", dest="fleet_summary",
+                    help="fleet summary JSON (daemon fleet --summary) "
+                         "to gate with the fleet rule set (failover "
+                         "implies lease expiry, transport retries "
+                         "bounded, no orphaned leases, exactly-once "
+                         "completion, worker attribution)")
     ap.add_argument("--lint", action="store_true",
                     help="run the graft-lint static rule set over the "
                          "source tree (donation safety, signature "
@@ -602,10 +721,11 @@ def main(argv=None) -> int:
 
     if not any((args.trace, args.metrics, args.devprof, args.row,
                 args.corpus, args.serve_summary, args.daemon_summary,
-                args.lint)):
+                args.fleet_summary, args.lint)):
         ap.error("nothing to check: give at least one of --trace / "
                  "--metrics / --devprof / --row / --corpus / "
-                 "--serve-summary / --daemon-summary / --lint")
+                 "--serve-summary / --daemon-summary / "
+                 "--fleet-summary / --lint")
 
     errs, notes = [], []
     try:
@@ -667,6 +787,10 @@ def main(argv=None) -> int:
             de, dn = check_daemon(_read_json(args.daemon_summary))
             errs += de
             notes += dn
+        if args.fleet_summary:
+            fe, fn = check_fleet(_read_json(args.fleet_summary))
+            errs += fe
+            notes += fn
         if args.lint:
             le, ln = check_lint(args.lint_root)
             errs += le

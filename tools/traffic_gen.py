@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Synthetic multi-tenant traffic generator for the route daemon/fleet.
+
+Replays `netlist/generate.py`-style random circuits as a SEEDED
+submission stream: every job is a synth spec whose circuit seed, name,
+tenant, priority and (optional) deadline are drawn from one RNG, so a
+traffic run is replayable — same seed, same stream, byte for byte.
+The grid parameters (luts/chan_width) are fixed per stream because a
+daemon serves ONE device graph; the *circuits* vary by seed, which is
+exactly how `flow.synth_flow` randomizes structure.
+
+Two delivery paths, same durable protocol:
+
+    # straight to the inbox files (daemon.submit_job)
+    python tools/traffic_gen.py --inbox box/ --jobs 8 --tenants 3 \
+        --luts 15 --seed 7
+
+    # over the fleet's HTTP transport (idempotent retrying client)
+    python tools/traffic_gen.py --url http://127.0.0.1:8077 --jobs 4 \
+        --tenants 2 --luts 15 --seed 7
+    python tools/traffic_gen.py --url @box/transport.json ...   # from
+        the fleet supervisor's published endpoint file
+
+Prints one JSON summary (submissions, per-tenant counts, retries) —
+the CI fleet-smoke parses it.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="seeded multi-tenant submission stream against a "
+                    "route daemon inbox or fleet transport")
+    tgt = p.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--inbox", default="",
+                     help="submit via the durable file protocol")
+    tgt.add_argument("--url", default="",
+                     help="submit over the HTTP transport; @FILE reads "
+                     "the URL from a fleet transport.json")
+    p.add_argument("--jobs", type=int, default=4)
+    p.add_argument("--tenants", type=int, default=2)
+    p.add_argument("--luts", type=int, default=10,
+                   help="grid size (must match the daemon's graph)")
+    p.add_argument("--chan_width", type=int, default=16)
+    p.add_argument("--seed", type=int, default=1,
+                   help="stream seed: circuits, tenants, priorities "
+                   "and gaps all replay from it")
+    p.add_argument("--max_iterations", type=int, default=0)
+    p.add_argument("--deadline_s", type=float, default=0.0,
+                   help="per-job deadline drawn up to this bound "
+                   "(0 = no deadlines)")
+    p.add_argument("--gap_s", type=float, default=0.0,
+                   help="mean seeded inter-submission gap "
+                   "(0 = submit as fast as possible)")
+    p.add_argument("--prefix", default="tg",
+                   help="job_id prefix (keep streams distinguishable)")
+    p.add_argument("--retries", type=int, default=4,
+                   help="transport client attempt cap")
+    p.add_argument("--timeout_s", type=float, default=10.0)
+    return p
+
+
+def make_stream(args) -> list:
+    """The seeded submission plan, fully determined before delivery:
+    delivery retries/drops can never change WHAT gets submitted."""
+    rng = random.Random(args.seed)
+    out = []
+    for i in range(args.jobs):
+        tenant = f"t{rng.randrange(args.tenants)}"
+        circuit_seed = rng.randrange(1, 10_000)
+        job = {
+            "job_id": f"{args.prefix}-{args.seed}-{i:03d}",
+            "tenant": tenant,
+            "priority": rng.randrange(0, 3),
+            "gap_s": (rng.expovariate(1.0 / args.gap_s)
+                      if args.gap_s > 0 else 0.0),
+            "spec": {"luts": args.luts, "chan_width": args.chan_width,
+                     "seed": circuit_seed,
+                     "name": f"l{args.luts}_s{circuit_seed}"},
+        }
+        if args.max_iterations:
+            job["spec"]["max_iterations"] = args.max_iterations
+        if args.deadline_s > 0:
+            job["deadline_s"] = round(
+                rng.uniform(0.5, 1.0) * args.deadline_s, 3)
+        out.append(job)
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    stream = make_stream(args)
+    url = args.url
+    if url.startswith("@"):
+        with open(url[1:]) as f:
+            url = json.loads(f.read())["url"]
+    client = None
+    if url:
+        from parallel_eda_tpu.serve.transport import TransportClient
+        client = TransportClient(url, timeout_s=args.timeout_s,
+                                 max_attempts=args.retries)
+    else:
+        from parallel_eda_tpu.serve.daemon import submit_job
+    submitted, per_tenant = [], {}
+    t0 = time.perf_counter()
+    for job in stream:
+        if job["gap_s"]:
+            time.sleep(job["gap_s"])
+        if client is not None:
+            job_id = client.submit(
+                job["spec"], tenant=job["tenant"],
+                priority=job["priority"],
+                deadline_s=job.get("deadline_s"),
+                job_id=job["job_id"])
+        else:
+            job_id = submit_job(
+                args.inbox, job["spec"], tenant=job["tenant"],
+                priority=job["priority"],
+                deadline_s=job.get("deadline_s"),
+                job_id=job["job_id"])
+        submitted.append(job_id)
+        per_tenant[job["tenant"]] = per_tenant.get(job["tenant"], 0) + 1
+    print(json.dumps({
+        "target": url or args.inbox,
+        "seed": args.seed,
+        "submitted": submitted,
+        "per_tenant": per_tenant,
+        "transport_retries": client.retries if client else 0,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
