@@ -143,6 +143,10 @@ class MetricsRegistry:
         self.snapshots.append(snap)
         tr = get_tracer()
         if tr is not None:
+            # declare the full mirrored family even when a member never
+            # samples in this run, so trace_report can report "empty
+            # track" instead of a degenerate range or silence
+            tr.declare_counter_tracks(COUNTER_TRACKS)
             for name in COUNTER_TRACKS:
                 v = snap["values"].get(name)
                 if isinstance(v, (int, float)) and not isinstance(v,

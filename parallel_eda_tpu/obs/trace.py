@@ -22,7 +22,9 @@ Two things the tp.h design could not give us come for free here:
 
 from __future__ import annotations
 
+import collections
 import json
+import os
 import threading
 import time
 from typing import Optional
@@ -70,9 +72,11 @@ class Tracer:
     is the OS thread ident so Perfetto draws one track per thread.
     """
 
-    def __init__(self):
+    def __init__(self, worker: str = ""):
         self.t0 = time.perf_counter()
+        self.worker = str(worker)
         self.events: list = []
+        self.declared_counter_tracks: set = set()
         self._lock = threading.Lock()
 
     def span(self, name: str, cat: str = "flow", **args) -> _Span:
@@ -108,6 +112,26 @@ class Tracer:
         with self._lock:
             self.events.append(ev)
 
+    def beacon(self, **args) -> None:
+        """Clock-sync beacon: one instant carrying a paired absolute
+        wall-clock / perf_counter sample taken back to back.  A merge
+        tool (tools/trace_merge.py) uses the (wall, ts) pairs to place
+        each per-process shard's private perf_counter origin on the
+        shared wall timeline; emitting one at start and one per cycle
+        both anchors the shard and exposes wall-clock steps as beacon
+        origin spread (the residual-skew bound the fleet doctor
+        checks)."""
+        self.instant("route.trace.beacon", cat="trace",
+                     wall=time.time(), perf=time.perf_counter(), **args)
+
+    def declare_counter_tracks(self, names) -> None:
+        """Declare counter tracks that SHOULD exist in this shard even
+        if no sample was ever recorded (e.g. place.t in a route-only
+        run).  Exported as "declaredCounterTracks" so trace_report can
+        tell an empty-but-declared track from an unknown name."""
+        with self._lock:
+            self.declared_counter_tracks.update(str(n) for n in names)
+
     def counter(self, name: str, value, cat: str = "metrics") -> None:
         """Record one sample of a Perfetto counter track ("C" event)
         on the span clock origin, so trajectories (overuse, pres_fac,
@@ -128,14 +152,72 @@ class Tracer:
                        if e["ph"] == "X"
                        and e["name"].startswith(name_prefix)) / 1e6
 
-    def export(self, path: str) -> None:
+    def export(self, path: str, atomic: bool = False) -> None:
+        """Write the shard.  atomic=True goes through tmp+os.replace so
+        a reader (or the fleet merge after a SIGKILL) never sees a torn
+        file — the per-cycle shard export depends on this: the last
+        fully written cycle survives the kill."""
         with self._lock:
             evs = sorted(self.events, key=lambda e: e["ts"])
+            tracks = sorted(self.declared_counter_tracks)
+        pname = "parallel_eda_tpu" + (f" {self.worker}" if self.worker
+                                      else "")
         meta = [{"name": "process_name", "ph": "M", "pid": 1, "ts": 0,
-                 "args": {"name": "parallel_eda_tpu"}}]
-        with open(path, "w") as f:
-            json.dump({"traceEvents": meta + evs,
-                       "displayTimeUnit": "ms"}, f)
+                 "args": {"name": pname}}]
+        doc = {"traceEvents": meta + evs, "displayTimeUnit": "ms"}
+        if self.worker:
+            doc["worker"] = self.worker
+        if tracks:
+            doc["declaredCounterTracks"] = tracks
+        if atomic:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        else:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+
+
+class FlightRecorder:
+    """Always-on bounded ring of recent lifecycle notes and metric
+    deltas for ONE worker — the black box that survives into the diag
+    bundle when a job dies.
+
+    Deliberately independent of the Tracer: the ring costs one deque
+    append per note and exists even when no trace sink is configured
+    (the tracer's null fast path stays a true no-op; the recorder is
+    only instantiated by the daemon layer, never by plain library
+    usage).  No metrics-registry import either — obs/metrics.py imports
+    this module, so the dependency must stay one-way."""
+
+    def __init__(self, capacity: int = 256, clock=time.monotonic,
+                 wall=time.time):
+        self.capacity = max(1, int(capacity))
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._clock = clock
+        self._wall = wall
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def note(self, kind: str, **fields) -> None:
+        ev = {"kind": kind, "mono": round(self._clock(), 6),
+              "wall": round(self._wall(), 6)}
+        ev.update(fields)
+        with self._lock:
+            self._ring.append(ev)
+            self.total += 1
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy for the diag bundle: the ring's events
+        oldest-first plus how much history fell off the end."""
+        with self._lock:
+            events = list(self._ring)
+            total = self.total
+        return {"capacity": self.capacity, "recorded": total,
+                "dropped": max(0, total - len(events)),
+                "events": events}
 
 
 # ---- process-wide tracer + the disabled fast path ----

@@ -303,6 +303,10 @@ class LeaseStore:
                             self._doc(job_id, 1, state, **extra))
         if ok:
             get_metrics().counter("route.fleet.leases_acquired").inc()
+            tr = get_tracer()
+            if tr is not None:
+                tr.instant("route.fleet.lease.acquire", cat="fleet",
+                           job_id=job_id, worker=self.worker)
         return ok
 
     def renew(self, job_id: str, state: Optional[str] = None,
@@ -346,6 +350,14 @@ class LeaseStore:
             stolen_from=doc.get("worker")))
         if ok:
             m.counter("route.fleet.lease_steals").inc()
+            tr = get_tracer()
+            if tr is not None:
+                # the steal link: the instant that joins a failed-over
+                # job's chain across two worker tracks in a merged trace
+                tr.instant("route.fleet.lease.steal", cat="fleet",
+                           job_id=job_id, worker=self.worker,
+                           stolen_from=doc.get("worker"),
+                           generation=int(doc.get("generation", 0)) + 1)
         return ok
 
     def release(self, job_id: str, state: str = "done") -> bool:
@@ -357,6 +369,10 @@ class LeaseStore:
         doc.update(released=True, state=state,
                    released_wall=self._wall())
         _atomic_write_json(self.path(job_id), doc, rotate=True)
+        tr = get_tracer()
+        if tr is not None:
+            tr.instant("route.fleet.lease.release", cat="fleet",
+                       job_id=job_id, worker=self.worker, state=state)
         return True
 
     def owns(self, job_id: str) -> bool:
@@ -377,6 +393,10 @@ class LeaseStore:
         doc.update(expires_mono=self._clock(),
                    expires_wall=self._wall(), forced=True)
         _atomic_write_json(self.path(job_id), doc, rotate=True)
+        tr = get_tracer()
+        if tr is not None:
+            tr.instant("route.fleet.lease.force_expire", cat="fleet",
+                       job_id=job_id, worker=self.worker)
         return True
 
     def scan(self) -> dict:

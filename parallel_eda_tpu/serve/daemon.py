@@ -58,6 +58,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs.metrics import get_metrics
+from ..obs.trace import FlightRecorder, get_tracer
 from ..route.router import RouterOpts
 from .queue import JobState, RouteJob
 from .service import RouteService, ServeJobSpec
@@ -66,6 +67,7 @@ SUBMIT_NAME = "submit.jsonl"
 SPEC_DIR = "specs"
 REJECT_NAME = "rejected.jsonl"
 HEARTBEAT_NAME = "heartbeat.json"
+TELEMETRY_NAME = "telemetry.json"
 DRAIN_NAME = "DRAIN"
 LEASE_DIR = "leases"
 
@@ -78,6 +80,14 @@ def heartbeat_name(worker: str = "") -> str:
     workers each beat their own ``heartbeat.<worker>.json`` so peers
     (and the supervisor) can age every member independently."""
     return f"heartbeat.{worker}.json" if worker else HEARTBEAT_NAME
+
+
+def telemetry_name(worker: str = "") -> str:
+    """The worker's live telemetry snapshot next to its heartbeat:
+    rewritten atomically at slice boundaries, read by ``GET /metrics``
+    on the transport, ``daemon status --live`` and the fleet summary —
+    pure host memory, so a scrape never forces a device sync."""
+    return f"telemetry.{worker}.json" if worker else TELEMETRY_NAME
 
 
 def preferred_worker(job_id: str, workers: List[str]) -> str:
@@ -114,11 +124,17 @@ class DaemonOpts:
     lease_ttl_s: float = 10.0      # job-lease expiry on the mono clock
     foreign_grace_s: float = 3.0   # wait before claiming an unleased
     #                                job assigned to a silent peer
+    # ---- observability plane
+    trace_path: str = ""           # per-cycle trace shard export
+    #                                (empty = no shard; the tracer
+    #                                itself is installed by the CLI)
+    flight_capacity: int = 256     # flight-recorder ring depth
 
 
 def submit_job(inbox_dir: str, spec: dict, tenant: str = "default",
                priority: int = 0, deadline_s: Optional[float] = None,
-               job_id: str = "", ts: Optional[float] = None) -> str:
+               job_id: str = "", ts: Optional[float] = None,
+               trace: Optional[dict] = None) -> str:
     """Client half of the inbox protocol: atomically install the spec
     file, then publish the submission as ONE ``O_APPEND`` write — the
     same torn-only-ever-at-the-tail durability argument as
@@ -138,8 +154,19 @@ def submit_job(inbox_dir: str, spec: dict, tenant: str = "default",
     os.replace(tmp, spec_path)
     line = {"job_id": safe, "tenant": tenant, "priority": int(priority),
             "spec": spec_rel, "ts": time.time() if ts is None else ts}
+    if ts is None:
+        # trace-context stamp: a monotonic twin of the wall stamp, so a
+        # same-host consumer can measure inbox lag immune to NTP steps
+        # (replayed/explicit-ts lines stay wall-only — their mono origin
+        # is another boot's)
+        line["mono"] = time.monotonic()
     if deadline_s:
         line["deadline_s"] = float(deadline_s)
+    if trace:
+        # upstream trace context (e.g. the transport client's own
+        # submission instant) rides the line job_id-keyed, so the
+        # consumer's lifecycle instants can name the true origin
+        line["trace"] = dict(trace)
     data = (json.dumps(line, sort_keys=True) + "\n").encode("utf-8")
     fd = os.open(os.path.join(inbox_dir, SUBMIT_NAME),
                  os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o666)
@@ -384,6 +411,18 @@ class RouteDaemon:
         self.shed_causes: Dict[str, dict] = {}
         self.recovered_ids: List[str] = []
         self._subs: Dict[str, dict] = {}   # job_id -> submission line
+        # flight recorder: always on for a daemon (the black box the
+        # diag bundle dumps), regardless of whether a trace sink is
+        # configured — the tracer's null fast path is a separate knob
+        self.recorder = FlightRecorder(
+            capacity=self.opts.flight_capacity, clock=clock, wall=wall)
+        service.flight = self.recorder
+        self._telemetry_path = os.path.join(
+            inbox_dir, telemetry_name(self.worker))
+        self.last_verdicts: List[dict] = []   # bounded, newest last
+        self._last_slice: Optional[dict] = None
+        self._terminal_seen: set = set()
+        self._metric_last: Dict[str, float] = {}
         self._t0 = clock()
         self.cycles = 0
         self._idle_cycles = 0
@@ -434,6 +473,12 @@ class RouteDaemon:
             rec["worker"] = self.worker
         self.rejected[job_id] = rec
         get_metrics().counter("route.daemon.rejected").inc()
+        tr = get_tracer()
+        if tr is not None:
+            tr.instant("route.trace.reject", cat="lifecycle",
+                       job_id=job_id, code=str(reason.get("code")))
+        self.recorder.note("reject", job_id=job_id,
+                           code=str(reason.get("code")))
         self._append_reject_line(rec)
         if self.lease is not None:
             # terminal release: a rejected job must not look like a
@@ -538,10 +583,32 @@ class RouteDaemon:
                 # durable checkpoint (bit-identical by construction)
                 failover = True
                 recovery = True
-        ts = sub.get("ts")
-        if isinstance(ts, (int, float)):
-            get_metrics().gauge("route.daemon.inbox_lag_s").set(
-                round(max(0.0, self._wall() - ts), 3))
+        # inbox lag: prefer the submission's monotonic twin (immune to
+        # NTP steps — the same fix Heartbeat.read got), flag the source
+        # so a wall-only estimate is never mistaken for a mono one
+        ts, mono = sub.get("ts"), sub.get("mono")
+        lag = lag_src = None
+        if isinstance(mono, (int, float)):
+            age = time.monotonic() - mono
+            if age >= 0.0:   # a negative age means another boot's clock
+                lag, lag_src = age, "mono"
+        if lag is None and isinstance(ts, (int, float)):
+            lag, lag_src = self._wall() - ts, "wall"
+        if lag is not None:
+            m = get_metrics()
+            m.gauge("route.daemon.inbox_lag_s").set(
+                round(max(0.0, lag), 3))
+            m.gauge("route.daemon.inbox_lag_src").set(lag_src)
+        trace_ctx = sub.get("trace")
+        tr = get_tracer()
+        if tr is not None:
+            tr.instant("route.trace.submit", cat="lifecycle",
+                       job_id=job_id, tenant=tenant,
+                       lag_s=None if lag is None else round(lag, 6),
+                       age_src=lag_src,
+                       submit_wall=(trace_ctx.get("submit_wall")
+                                    if isinstance(trace_ctx, dict)
+                                    else None))
         try:
             spec = self._load_spec(str(sub.get("spec")))
             flow = self.flow_builder(spec)
@@ -593,11 +660,22 @@ class RouteDaemon:
         if failover:
             self.failed_over_ids.append(job_id)
             get_metrics().counter("route.fleet.jobs_failed_over").inc()
+            if tr is not None:
+                tr.instant("route.trace.failover", cat="lifecycle",
+                           job_id=job_id, worker=self.worker)
+            self.recorder.note("failover", job_id=job_id)
         if recovery:
             self.recovered_ids.append(job_id)
             get_metrics().counter("route.daemon.recovered").inc()
         else:
             get_metrics().counter("route.daemon.admitted").inc()
+        if tr is not None:
+            tr.instant("route.trace.admit", cat="lifecycle",
+                       job_id=job_id, tenant=tenant, nets=nets,
+                       recovery=recovery, failover=failover)
+        self.recorder.note("admit", job_id=job_id, tenant=tenant,
+                           nets=nets, recovery=recovery,
+                           failover=failover)
 
     # ------------------------------------------------- shedding
 
@@ -656,6 +734,12 @@ class RouteDaemon:
                 continue
             self.shed_causes[j.job_id] = cause
             get_metrics().counter("route.daemon.shed").inc()
+            tr = get_tracer()
+            if tr is not None:
+                tr.instant("route.trace.shed", cat="lifecycle",
+                           job_id=j.job_id, code=cause["code"])
+            self.recorder.note("shed", job_id=j.job_id,
+                               code=cause["code"])
             if self.lease is not None:
                 # the fleet shed it, the fleet won't retry it: release
                 # terminally so no peer mistakes it for dead-worker work
@@ -708,6 +792,13 @@ class RouteDaemon:
                             error=cause["detail"]) is not None:
                         self.shed_causes[j.job_id] = cause
                         fenced += 1
+                        tr = get_tracer()
+                        if tr is not None:
+                            tr.instant("route.trace.shed",
+                                       cat="lifecycle", job_id=j.job_id,
+                                       code=cause["code"])
+                        self.recorder.note("shed", job_id=j.job_id,
+                                           code=cause["code"])
                 elif doc.get("worker") == self.worker:
                     ls.renew(j.job_id)
             elif j.state in (JobState.DONE, JobState.FAILED,
@@ -737,8 +828,25 @@ class RouteDaemon:
     def _runner(self, job: RouteJob):
         """Queue runner: the service's, plus lease bookkeeping — a
         finished job releases terminally, a preempted one renews so a
-        long multi-slice job never lapses mid-flight."""
-        verdict, value = self.service._runner(job)
+        long multi-slice job never lapses mid-flight — wrapped in the
+        job's per-slice lifecycle span (the span records even when the
+        slice raises: the queue's verdict loop owns the exception)."""
+        tr = get_tracer()
+        if tr is None:
+            verdict, value = self.service._runner(job)
+        else:
+            with tr.span("route.trace.slice", cat="lifecycle",
+                         job_id=job.job_id, slice=job.slices + 1,
+                         worker=self.worker or "solo"):
+                verdict, value = self.service._runner(job)
+        self._last_slice = {"job_id": job.job_id,
+                            "slice": job.slices + 1, "verdict": verdict}
+        self.last_verdicts.append(
+            {"job_id": job.job_id, "verdict": verdict,
+             "slice": job.slices + 1, "ts": round(self._wall(), 3)})
+        del self.last_verdicts[:-8]
+        self.recorder.note("slice", job_id=job.job_id,
+                           slice=job.slices + 1, verdict=verdict)
         if self.lease is not None:
             if verdict == "done":
                 self.lease.release(job.job_id, state="done")
@@ -802,6 +910,95 @@ class RouteDaemon:
                 sub.setdefault("tenant", e.get("tenant", "default"))
                 self._admit_submission(sub, recovery=True)
 
+    # ------------------------------------------------- telemetry
+
+    def live_snapshot(self) -> dict:
+        """The live telemetry document: job table, held leases, recent
+        verdicts and current metric values — all host memory already in
+        hand, so building it never forces a device sync mid-window."""
+        q = self.service.queue
+        m = get_metrics()
+        doc = {"schema": 1, "worker": self.worker,
+               "ts": round(self._wall(), 3),
+               "mono": round(self._clock(), 3),
+               "cycle": self.cycles,
+               "queue_depth": q.depth(),
+               "draining": self.service.draining,
+               "in_flight": self._last_slice,
+               "jobs": {j.job_id: j.state.value for j in q.jobs},
+               "held_leases": (self.lease.held()
+                               if self.lease is not None else []),
+               "last_verdicts": list(self.last_verdicts),
+               "metrics": m.values("route.")}
+        return doc
+
+    def _write_telemetry(self) -> None:
+        """Atomic snapshot publish (tmp + os.replace): a scraper can
+        read mid-write and never sees a torn document.  No fsync — a
+        live snapshot needs rename atomicity, not power-loss
+        durability (stale-after-crash is fine; a per-cycle fsync is
+        not)."""
+        try:
+            tmp = self._telemetry_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.live_snapshot(), f, sort_keys=True,
+                          default=str)
+            os.replace(tmp, self._telemetry_path)
+        except OSError as e:
+            get_metrics().counter(
+                "route.daemon.snapshot_errors").inc()
+            self.recorder.note("telemetry_error", error=str(e))
+            return
+        get_metrics().counter("route.daemon.snapshot_writes").inc()
+
+    def _scan_terminal(self) -> None:
+        """Emit one terminal lifecycle instant per job as it reaches a
+        terminal state (whoever set it — runner verdict, shed, evict,
+        timeout), closing the job's trace chain."""
+        tr = get_tracer()
+        for j in self.service.queue.jobs:
+            if j.job_id in self._terminal_seen \
+                    or j.state in (JobState.QUEUED, JobState.RUNNING):
+                continue
+            self._terminal_seen.add(j.job_id)
+            if tr is not None:
+                tr.instant("route.trace.terminal", cat="lifecycle",
+                           job_id=j.job_id, state=j.state.value,
+                           slices=j.slices)
+            self.recorder.note("terminal", job_id=j.job_id,
+                               state=j.state.value, slices=j.slices)
+
+    def _flight_metric_deltas(self) -> None:
+        """Fold this cycle's daemon/serve/fleet/resil counter movement
+        into the flight ring — the diag bundle then shows WHAT was
+        moving in the last N cycles, not just the final totals."""
+        vals = get_metrics().values("route.")
+        deltas = {}
+        for name, v in vals.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            last = self._metric_last.get(name)
+            if last is None or v != last:
+                deltas[name] = round(v - (last or 0), 6)
+            self._metric_last[name] = v
+        if deltas:
+            self.recorder.note("metrics", cycle=self.cycles, **deltas)
+
+    def _export_shard(self) -> None:
+        """Per-cycle atomic trace-shard export: the merge (and a
+        post-SIGKILL post-mortem) always finds every cycle that
+        completed before the kill."""
+        tr = get_tracer()
+        if tr is None or not self.opts.trace_path:
+            return
+        try:
+            tr.export(self.opts.trace_path, atomic=True)
+        except OSError as e:
+            get_metrics().counter("route.trace.shard_errors").inc()
+            self.recorder.note("shard_error", error=str(e))
+            return
+        get_metrics().counter("route.trace.shard_writes").inc()
+
     # ------------------------------------------------- main loop
 
     def request_stop(self) -> None:
@@ -815,6 +1012,12 @@ class RouteDaemon:
         actually ran (0 = idle)."""
         self.cycles += 1
         q = self.service.queue
+        tr = get_tracer()
+        if tr is not None:
+            # per-cycle clock-sync beacon: the merge aligns this
+            # shard's perf origin to the wall timeline from these
+            tr.beacon(worker=self.worker or "solo", cycle=self.cycles)
+            get_metrics().counter("route.trace.beacons").inc()
         if self._drain_requested() and not self.service.draining:
             self.service.begin_drain()
         hb_state = {"queue_depth": q.depth(), "cycle": self.cycles,
@@ -845,6 +1048,11 @@ class RouteDaemon:
             q.run(self._runner, max_slices=1)
             hb_state["queue_depth"] = q.depth()
             self.heartbeat.beat(**hb_state)
+            self._scan_terminal()
+            # slice boundary: the device window just closed, so the
+            # snapshot (and shard) publish costs no mid-window sync
+            self._write_telemetry()
+            self._export_shard()
         if q.depth() == 0:
             self._lease_sweep()   # release freshly-terminal leases
         ran = sum(j.slices for j in q.jobs) - before
@@ -853,12 +1061,23 @@ class RouteDaemon:
             round(self._clock() - self._t0, 3))
         m.gauge("route.daemon.queue_depth").set(q.depth())
         m.counter("route.daemon.cycles").inc()
+        self._scan_terminal()
+        self._flight_metric_deltas()
+        m.gauge("route.trace.flight_records").set(self.recorder.total)
+        self._write_telemetry()
         self._flush_journal()
+        self._export_shard()
         return ran
 
     def run(self, max_cycles: int = 0) -> List[RouteJob]:
         """Recover, then cycle until drained/idle/stopped.  Returns
         the queue's job list (terminal states set) for the summary."""
+        tr = get_tracer()
+        if tr is not None:
+            # start-of-life beacon: even a worker killed in its first
+            # cycle leaves an alignable shard
+            tr.beacon(worker=self.worker or "solo", cycle=0)
+            get_metrics().counter("route.trace.beacons").inc()
         self._recover()
         self._flush_journal()
         while not self._stop:
@@ -932,8 +1151,11 @@ class RouteDaemon:
                             "writes": self.journal.writes,
                             "entries": len(self._journal_entries())},
                 "recovered": self.recovered_ids,
+                "telemetry": {"file": self._telemetry_path,
+                              "flight_recorded": self.recorder.total},
                 "metrics": m.values("route.daemon."),
             },
+            "trace": m.values("route.trace."),
             "serve": m.values("route.serve."),
             "resil": {"metrics": m.values("route.resil.")},
         }

@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seeded fault spec site:count[:horizon],... "
                    "(worker-side sites, e.g. lease.steal)")
     r.add_argument("--chaos_seed", type=int, default=0)
+    r.add_argument("--trace", default="",
+                   help="write this worker's trace shard here (Chrome "
+                   "trace-event JSON, atomically re-exported every "
+                   "cycle: job lifecycle spans + clock-sync beacons; "
+                   "tools/trace_merge.py aligns shards fleet-wide)")
 
     s = sub.add_parser("submit", help="submit one synthetic job")
     s.add_argument("--inbox", required=True)
@@ -110,6 +115,13 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--inbox", required=True)
     t.add_argument("--stale_s", type=float, default=10.0,
                    help="exit 1 when the heartbeat is older than this")
+    t.add_argument("--live", action="store_true",
+                   help="include each worker's live telemetry snapshot "
+                   "(queue depth, in-flight job+slice, held leases, "
+                   "last verdicts) from telemetry.<worker>.json")
+    t.add_argument("--json", action="store_true",
+                   help="print the full machine-readable JSON document "
+                   "instead of the human-readable report")
 
     f = sub.add_parser(
         "fleet", help="spawn + supervise N replicated workers over "
@@ -156,6 +168,12 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--summary", default="",
                    help="write the aggregated fleet summary here "
                    "(atomic); flow_doctor --fleet-summary gates it")
+    f.add_argument("--trace", action="store_true",
+                   help="every worker writes a per-cycle trace shard "
+                   "(trace.<worker>.json); on exit the supervisor "
+                   "beacon-aligns them into <inbox>/trace.merged.json "
+                   "— one Perfetto timeline, one track per worker, "
+                   "job flows connected across failovers")
     return p
 
 
@@ -167,6 +185,12 @@ def _cmd_run(args) -> int:
     t_start = time.perf_counter()
     get_metrics().enabled = True
     worker = getattr(args, "worker", "")
+    trace_path = getattr(args, "trace", "")
+    if trace_path:
+        # install the process tracer BEFORE any daemon construction so
+        # recovery/lease instants of the very first cycle are captured
+        from ..obs.trace import Tracer, set_tracer
+        set_tracer(Tracer(worker=worker or "daemon"))
     roster = tuple(w for w in getattr(args, "workers", "").split(",")
                    if w) or ((worker,) if worker else ())
     opts = DaemonOpts(
@@ -179,7 +203,8 @@ def _cmd_run(args) -> int:
         exit_when_idle=args.exit_when_idle,
         worker=worker, workers=roster,
         lease_ttl_s=args.lease_ttl_s,
-        foreign_grace_s=args.foreign_grace_s)
+        foreign_grace_s=args.foreign_grace_s,
+        trace_path=trace_path)
     plan = None
     if args.chaos:
         from ..resil.faults import FaultPlan
@@ -202,6 +227,13 @@ def _cmd_run(args) -> int:
     signal.signal(signal.SIGINT, _graceful)
 
     jobs = daemon.run(max_cycles=args.max_cycles)
+    if trace_path:
+        # final shard flush: instants emitted after the last cycle's
+        # export (terminal lease releases, drain) must not be lost
+        from ..obs.trace import get_tracer
+        tr = get_tracer()
+        if tr is not None:
+            tr.export(trace_path, atomic=True)
     summary = daemon.summary()
     summary["wall_s"] = round(time.perf_counter() - t_start, 3)
     blob = json.dumps(summary, default=str)
@@ -233,12 +265,12 @@ def _cmd_submit(args) -> int:
     return 0
 
 
-def _cmd_status(args) -> int:
+def _status_doc(args) -> dict:
     from ..resil.journal import Heartbeat, JournalStore
-    from .daemon import HEARTBEAT_NAME
+    from .daemon import HEARTBEAT_NAME, TELEMETRY_NAME
     # one inbox may host a solo daemon (heartbeat.json) or a fleet
     # (heartbeat.<worker>.json each): aggregate whatever is there
-    hbs = {}
+    hbs, live = {}, {}
     try:
         names = sorted(os.listdir(args.inbox))
     except OSError:
@@ -248,6 +280,14 @@ def _cmd_status(args) -> int:
             key = "daemon"
         elif name.startswith("heartbeat.") and name.endswith(".json"):
             key = name[len("heartbeat."):-len(".json")]
+        elif name == TELEMETRY_NAME or (name.startswith("telemetry.")
+                                        and name.endswith(".json")):
+            # the live snapshot carries ts+mono like a heartbeat, so
+            # Heartbeat.read ages it with the same NTP-step immunity
+            key = "daemon" if name == TELEMETRY_NAME \
+                else name[len("telemetry."):-len(".json")]
+            live[key] = Heartbeat.read(os.path.join(args.inbox, name))
+            continue
         else:
             continue
         hbs[key] = Heartbeat.read(os.path.join(args.inbox, name))
@@ -267,10 +307,48 @@ def _cmd_status(args) -> int:
     out = {"heartbeats": hbs, "journal_jobs": states,
            "workers_alive": sum(alive.values()),
            "alive": any(alive.values())}
+    if getattr(args, "live", False):
+        out["live"] = live
     # back-compat: the solo shape keeps its historical top-level key
     if list(hbs) == ["daemon"]:
         out["heartbeat"] = hbs["daemon"]
-    print(json.dumps(out, default=str))
+    return out
+
+
+def _print_status(out: dict) -> None:
+    """Human-readable status report (the --json flag prints the raw
+    document instead)."""
+    for key, hb in sorted(out["heartbeats"].items()):
+        age = hb.get("age_s", float("inf"))
+        print(f"{key}: age={age:.2f}s"
+              f" src={hb.get('age_src', '?')}"
+              f" cycle={hb.get('cycle', '?')}"
+              f" queue={hb.get('queue_depth', '?')}"
+              f" draining={hb.get('draining', False)}")
+    if out.get("journal_jobs"):
+        print("journal: " + " ".join(
+            f"{s}={n}" for s, n in sorted(out["journal_jobs"].items())))
+    for key, t in sorted(out.get("live", {}).items()):
+        inf = t.get("in_flight") or {}
+        print(f"{key} live: cycle={t.get('cycle', '?')}"
+              f" queue={t.get('queue_depth', '?')}"
+              f" in_flight={inf.get('job_id', '-')}"
+              f"#{inf.get('slice', '-')}"
+              f" leases={len(t.get('held_leases') or [])}"
+              f" verdicts={len(t.get('last_verdicts') or [])}")
+        for v in (t.get("last_verdicts") or [])[-3:]:
+            print(f"  {v.get('job_id')}: {v.get('verdict')}"
+                  f" (slice {v.get('slice')})")
+    print(f"alive: {out['workers_alive']} worker(s)"
+          if out["alive"] else "alive: NO live heartbeat")
+
+
+def _cmd_status(args) -> int:
+    out = _status_doc(args)
+    if args.json:
+        print(json.dumps(out, default=str))
+    else:
+        _print_status(out)
     return 0 if out["alive"] else 1
 
 
@@ -293,7 +371,8 @@ def _cmd_fleet(args) -> int:
         chaos_seed=args.chaos_seed, chaos=args.chaos,
         transport=not args.no_transport,
         host=args.host, port=args.port,
-        expect_jobs=args.expect_jobs, tick_s=args.tick_s)
+        expect_jobs=args.expect_jobs, tick_s=args.tick_s,
+        trace=args.trace)
     sup = FleetSupervisor(args.inbox, opts)
     summary = sup.run(timeout_s=args.timeout_s)
     blob = json.dumps(summary, default=str)

@@ -43,7 +43,7 @@ from typing import Dict, List, Optional
 
 from ..obs.metrics import get_metrics
 from ..resil.journal import Heartbeat, LeaseStore, _atomic_write_json
-from .daemon import LEASE_DIR, DRAIN_NAME, heartbeat_name
+from .daemon import LEASE_DIR, DRAIN_NAME, heartbeat_name, telemetry_name
 from .transport import InboxHTTPServer
 
 #: chaos sites the supervisor itself owns; everything else in a fleet
@@ -92,6 +92,10 @@ class FleetOpts:
     expect_jobs: int = 0           # stop once this many leases released
     tick_s: float = 0.5            # monitor period
     stale_after_s: float = 5.0     # heartbeat age that counts as dead
+    trace: bool = False            # per-worker trace shards + merged
+    #                                fleet trace (trace.merged.json)
+    skew_bound_ms: float = 250.0   # declared post-align residual-skew
+    #                                bound the fleet doctor gates
     extra_worker_args: List[str] = field(default_factory=list)
 
 
@@ -122,6 +126,9 @@ class FleetSupervisor:
 
     def _summary_path(self, worker: str) -> str:
         return os.path.join(self.inbox_dir, f"summary.{worker}.json")
+
+    def _shard_path(self, worker: str) -> str:
+        return os.path.join(self.inbox_dir, f"trace.{worker}.json")
 
     def _worker_cmd(self, worker: str) -> List[str]:
         o = self.opts
@@ -158,6 +165,8 @@ class FleetSupervisor:
         if self.worker_chaos:
             cmd += ["--chaos", self.worker_chaos,
                     "--chaos_seed", str(o.chaos_seed)]
+        if o.trace:
+            cmd += ["--trace", self._shard_path(worker)]
         return cmd + list(o.extra_worker_args)
 
     def start(self) -> "FleetSupervisor":
@@ -196,6 +205,20 @@ class FleetSupervisor:
                       <= self.opts.stale_after_s}
         return out
 
+    def _victim_sliced(self, worker: str) -> bool:
+        """True once ``worker``'s telemetry snapshot shows a completed
+        slice.  The daemon publishes that at the same slice boundary
+        that exports its trace shard, so a victim passing this check
+        has a slice span on disk — the merged fleet trace can then
+        render the failover as a chain CROSSING worker tracks instead
+        of a track that dies empty."""
+        try:
+            with open(os.path.join(
+                    self.inbox_dir, telemetry_name(worker))) as f:
+                return bool(json.load(f).get("in_flight"))
+        except (OSError, ValueError):
+            return False
+
     def _chaos_worker_kill(self) -> None:
         if self.plan is None:
             return
@@ -209,6 +232,11 @@ class FleetSupervisor:
         holders = sorted({d.get("worker") for d in
                           self.leases.scan().values()
                           if not d.get("released")} & set(alive))
+        # with tracing on, additionally require a victim that has
+        # EXPORTED a slice (first slices are compile-heavy; killing
+        # inside one leaves a shard with no span to link the failover)
+        if self.opts.trace:
+            holders = [w for w in holders if self._victim_sliced(w)]
         if not holders:
             return
         f = self.plan.fire("worker.kill", detail=",".join(holders))
@@ -299,6 +327,73 @@ class FleetSupervisor:
         except (OSError, ValueError):
             return None
 
+    def _scrape_telemetry(self) -> Dict[str, dict]:
+        """Condensed final view of every worker's live telemetry
+        snapshot — the same files ``GET /metrics`` serves, scraped
+        into the fleet summary so a post-mortem has each member's
+        last-published state even when the worker died too hard to
+        write a summary."""
+        out: Dict[str, dict] = {}
+        for w in self.roster:
+            p = os.path.join(self.inbox_dir, f"telemetry.{w}.json")
+            try:
+                with open(p) as f:
+                    t = json.load(f)
+                if not isinstance(t, dict):
+                    raise ValueError("telemetry is not an object")
+            except (OSError, ValueError) as e:
+                out[w] = {"error": str(e)}
+                continue
+            out[w] = {"cycle": t.get("cycle"),
+                      "ts": t.get("ts"),
+                      "queue_depth": t.get("queue_depth"),
+                      "in_flight": t.get("in_flight"),
+                      "held_leases": t.get("held_leases"),
+                      "jobs": t.get("jobs"),
+                      "last_verdicts": t.get("last_verdicts")}
+        return out
+
+    def _merge_traces(self) -> Optional[dict]:
+        """Supervisor-side shard merge: load ``tools/trace_merge.py``
+        by file path (tools/ is not a package), beacon-align every
+        worker's shard onto one wall timeline and write the single
+        Perfetto document ``<inbox>/trace.merged.json``.  Merge
+        failures are recorded, never raised — observability must not
+        fail the fleet."""
+        if not self.opts.trace:
+            return None
+        shards = [p for p in (self._shard_path(w) for w in self.roster)
+                  if os.path.exists(p)]
+        if not shards:
+            return {"error": "no trace shards found", "shards": []}
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        tool = os.path.join(repo, "tools", "trace_merge.py")
+        out_path = os.path.join(self.inbox_dir, "trace.merged.json")
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "_trace_merge", tool)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            doc = mod.merge(shards,
+                            skew_bound_ms=self.opts.skew_bound_ms)
+            blob = json.dumps(doc)
+            tmp = out_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, out_path)
+        except (OSError, ValueError, ImportError, AttributeError) as e:
+            get_metrics().counter(
+                "route.fleet.trace_merge_errors").inc()
+            return {"error": f"{type(e).__name__}: {e}",
+                    "shards": shards}
+        meta = doc.get("traceMergeMeta") or {}
+        return {"merged": out_path, "shards": shards,
+                "events": len(doc.get("traceEvents") or []),
+                "residual_skew_ms": meta.get("residual_skew_ms"),
+                "skew_bound_ms": meta.get("skew_bound_ms")}
+
     def summary(self, serve_wall_s: float = 0.0) -> dict:
         """The ``flow_doctor --fleet-summary`` artifact: merged job
         rows (worker-attributed), fleet-wide route.fleet.* metrics
@@ -349,6 +444,8 @@ class FleetSupervisor:
                 "faults": (self.plan.summary()
                            if self.plan is not None else None),
                 "worker_chaos": self.worker_chaos,
+                "telemetry": self._scrape_telemetry(),
+                "trace": self._merge_traces(),
                 "metrics": merged,
                 "aggregate": {
                     "nets": nets,

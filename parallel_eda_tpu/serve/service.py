@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..obs.metrics import get_metrics
+from ..obs.trace import get_tracer
 from ..resil import Resilience, ResilOpts
 from ..resil.watchdog import DispatchPoisonedError
 from ..route.router import Router, RouterOpts
@@ -80,6 +81,9 @@ class RouteService:
         # returning attribution fields (worker id, held leases) that
         # every diagnostic bundle must carry
         self.diag_extra: Optional[Callable[[], dict]] = None
+        # flight recorder injected by the daemon layer: a bounded ring
+        # of recent lifecycle notes dumped into the diag bundle
+        self.flight = None
 
     # ------------------------------------------------------- admit
 
@@ -151,6 +155,12 @@ class RouteService:
             # durable snapshot — bit-identical, the resume path just
             # replays the remaining deterministic iterations
             ck = rt.store.load(job.job_id)
+            if ck is not None:
+                tr = get_tracer()
+                if tr is not None:
+                    tr.instant("route.trace.resume", cat="lifecycle",
+                               job_id=job.job_id,
+                               it_done=int(getattr(ck, "it_done", 0)))
         # slice via RouterOpts.slice_iterations (cooperative yield at a
         # window boundary), NOT by shrinking max_router_iterations —
         # the iteration budget feeds the router's per-window K clamp,
@@ -309,6 +319,10 @@ class RouteService:
             "faults": rt.plan.summary() if rt.plan is not None else None,
             "checkpoint": ck_meta,
             "resil_metrics": get_metrics().values("route.resil."),
+            # the flight recorder's recent history: what the worker was
+            # doing in the cycles leading up to this burial
+            "flight_recorder": (self.flight.snapshot()
+                                if self.flight is not None else None),
         }
         if callable(self.diag_extra):
             # fleet attribution: which worker buried this job, holding

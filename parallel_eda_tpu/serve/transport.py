@@ -47,7 +47,13 @@ class InboxHTTPServer:
         POST /submit    {"spec": {...}, "tenant", "priority",
                          "deadline_s", "job_id"}  ->  {"job_id": ...}
         GET  /healthz   liveness + inbox path
-        GET  /status    transport counters (requests/drops/retries)
+        GET  /status    transport counters + per-worker live state
+        GET  /metrics   the fleet's live telemetry: every worker's
+                        atomically-published snapshot (job table, held
+                        leases, metric values) read back from
+                        ``telemetry.<worker>.json`` — pure file reads
+                        on the HTTP thread, so a scrape NEVER forces a
+                        device sync in any worker
 
     ``plan`` arms the ``transport.drop`` site: a scheduled firing
     closes the connection before any durable write, exactly the
@@ -84,7 +90,9 @@ class InboxHTTPServer:
                     self._reply(200, {"ok": True,
                                       "inbox": outer.inbox_dir})
                 elif self.path == "/status":
-                    self._reply(200, outer.summary())
+                    self._reply(200, outer.status())
+                elif self.path == "/metrics":
+                    self._reply(200, outer.metrics_snapshot())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -116,12 +124,14 @@ class InboxHTTPServer:
                     # written — the inbox never sees a partial job
                     self._reply(400, {"error": f"bad submission: {e}"})
                     return
+                trace = body.get("trace")
                 job_id = submit_job(
                     outer.inbox_dir, body["spec"],
                     tenant=str(body.get("tenant") or "default"),
                     priority=int(body.get("priority", 0)),
                     deadline_s=body.get("deadline_s"),
-                    job_id=str(body.get("job_id") or ""))
+                    job_id=str(body.get("job_id") or ""),
+                    trace=trace if isinstance(trace, dict) else None)
                 self._reply(200, {"job_id": job_id, "ok": True})
 
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -171,6 +181,60 @@ class InboxHTTPServer:
                     "max_attempt_seen": self.max_attempt_seen,
                     "retry_cap_seen": self.retry_cap_seen}
 
+    def _telemetry_docs(self) -> dict:
+        """Every worker's atomically-published telemetry snapshot,
+        keyed by worker id ("daemon" for a solo instance).  Snapshots
+        are written tmp+os.replace at slice boundaries, so a read here
+        is never torn; a missing/unparsable file just means that
+        worker has not published yet (counted, not fatal)."""
+        out = {}
+        try:
+            names = sorted(os.listdir(self.inbox_dir))
+        except OSError:
+            return out
+        for name in names:
+            if name == "telemetry.json":
+                key = "daemon"
+            elif name.startswith("telemetry.") \
+                    and name.endswith(".json"):
+                key = name[len("telemetry."):-len(".json")]
+            else:
+                continue
+            try:
+                with open(os.path.join(self.inbox_dir, name)) as f:
+                    doc = json.load(f)
+                if not isinstance(doc, dict):
+                    raise ValueError("telemetry is not an object")
+            except (OSError, ValueError, UnicodeDecodeError):
+                get_metrics().counter(
+                    "route.fleet.telemetry_read_errors").inc()
+                continue
+            out[key] = doc
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        """``GET /metrics``: the fleet's live state as of each
+        worker's last slice boundary."""
+        get_metrics().counter("route.fleet.metrics_scrapes").inc()
+        return {"ts": time.time(),
+                "workers": self._telemetry_docs(),
+                "transport": self.summary()}
+
+    def status(self) -> dict:
+        """``GET /status``: transport counters (the historical shape)
+        enriched with a condensed per-worker liveness view."""
+        doc = self.summary()
+        workers = {}
+        for key, t in self._telemetry_docs().items():
+            workers[key] = {
+                "cycle": t.get("cycle"),
+                "queue_depth": t.get("queue_depth"),
+                "in_flight": t.get("in_flight"),
+                "held_leases": t.get("held_leases"),
+                "draining": t.get("draining")}
+        doc["workers"] = workers
+        return doc
+
 
 class TransportError(RuntimeError):
     """Submission failed after the full retry budget."""
@@ -215,7 +279,12 @@ class TransportClient:
         job_id = "".join(c if (c.isalnum() or c in "-_.") else "_"
                          for c in job_id)
         doc = {"spec": spec, "tenant": tenant, "priority": int(priority),
-               "job_id": job_id}
+               "job_id": job_id,
+               # trace context, stamped ONCE before the first attempt:
+               # retries resubmit the identical payload, so the origin
+               # instant survives any number of redeliveries
+               "trace": {"submit_wall": round(time.time(), 6),
+                         "client": "transport"}}
         if deadline_s:
             doc["deadline_s"] = float(deadline_s)
         last: Optional[Exception] = None

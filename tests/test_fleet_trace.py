@@ -1,0 +1,549 @@
+"""Fleet-wide distributed tracing and the live telemetry plane.
+
+Four layers:
+
+* merge units — tools/trace_merge.py beacon alignment on hand-built
+  shards: skewed perf origins land on one wall timeline, a shard
+  without beacons is rejected, a wall-clock step mid-run surfaces as
+  residual skew, and each job's slice spans get connected into one
+  Perfetto flow across worker tracks;
+* doctor rules — flow_doctor --fleet-trace over crafted merged
+  traces: contiguous lifecycle chains, orphaned slices, disconnected
+  failovers, coded verdict instants, the skew bound;
+* daemon loop — a RouteDaemon with a live tracer emits the full
+  lifecycle (submit/admit/slice/terminal + beacons), exports its
+  shard atomically every cycle, publishes telemetry snapshots, and
+  keeps the flight recorder rolling; with tracing off, all of it
+  stays a true no-op;
+* telemetry plane — GET /metrics served from the atomically-published
+  snapshots (never a device sync), the inbox-lag monotonic/wall
+  source flag, and the flight recorder's ring landing in the diag
+  bundle.
+
+    python -m pytest tests/ -m fleet
+"""
+
+import importlib.util
+import json
+import os
+import types
+from urllib import request as urlrequest
+
+import pytest
+
+from parallel_eda_tpu.obs import MetricsRegistry, get_metrics, set_metrics
+from parallel_eda_tpu.obs.trace import (FlightRecorder, Tracer,
+                                        get_tracer, set_tracer)
+from parallel_eda_tpu.resil.journal import LeaseStore
+from parallel_eda_tpu.serve.daemon import (DaemonOpts, RouteDaemon,
+                                           submit_job, telemetry_name)
+from parallel_eda_tpu.serve.queue import JobQueue, JobState, RouteJob
+from parallel_eda_tpu.serve.transport import InboxHTTPServer
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    set_metrics(MetricsRegistry())
+    set_tracer(None)
+    yield
+    set_metrics(MetricsRegistry())
+    set_tracer(None)
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(TOOLS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeFlow:
+    def __init__(self, nets):
+        self.term = types.SimpleNamespace(source=list(range(nets)))
+
+
+class _FakeService:
+    def __init__(self, clock, runner=None):
+        self.queue = JobQueue(clock=clock, sleep=lambda s: None)
+        self.draining = False
+        self.runs_dir = None
+        self.scenario = "trace-fake"
+        self.router = types.SimpleNamespace(_library=None)
+        self.resil = None
+        self.diag_extra = None
+        self.runner = runner or (
+            lambda job: ("done", {"wirelength": 7, "iterations": 2,
+                                  "nets": len(job.payload.term.source)}))
+
+    def begin_drain(self):
+        self.draining = True
+
+    def admit(self, spec, tenant="default", priority=0,
+              deadline_s=None, max_retries=0, job_id=""):
+        if self.draining:
+            raise RuntimeError("service is draining")
+        job = RouteJob(tenant=tenant, payload=spec, job_id=job_id,
+                       priority=priority, deadline_s=deadline_s,
+                       max_retries=max_retries)
+        return self.queue.admit(job)
+
+    def _runner(self, job):
+        return self.runner(job)
+
+
+def _mk_daemon(tmp_path, clock=None, opts=None, runner=None):
+    clock = clock or _Clock()
+    svc = _FakeService(clock, runner=runner)
+    d = RouteDaemon(
+        svc, str(tmp_path / "box"),
+        opts or DaemonOpts(default_nets_per_s=10.0,
+                           cold_start_factor=1.0, exit_when_idle=1),
+        flow_builder=lambda spec: _FakeFlow(int(spec.get("nets", 10))),
+        clock=clock, wall=lambda: 1000.0 + clock.t,
+        sleep=lambda s: setattr(clock, "t", clock.t + s))
+    return d, svc, clock
+
+
+# ---- shard builders (merge units, no jax) --------------------------
+
+def _beacon(ts_us, wall):
+    return {"name": "route.trace.beacon", "ph": "i", "cat": "trace",
+            "s": "t", "ts": ts_us, "pid": 1, "tid": 1,
+            "args": {"wall": wall, "perf": ts_us / 1e6}}
+
+
+def _slice(job_id, ts_us, dur_us=1000.0, n=1, worker="w"):
+    return {"name": "route.trace.slice", "ph": "X", "cat": "lifecycle",
+            "ts": ts_us, "dur": dur_us, "pid": 1, "tid": 1,
+            "args": {"job_id": job_id, "slice": n, "worker": worker}}
+
+
+def _instant(name, ts_us, **args):
+    return {"name": name, "ph": "i", "cat": "lifecycle", "s": "t",
+            "ts": ts_us, "pid": 1, "tid": 1, "args": args}
+
+
+def _write_shard(path, worker, origin, events, step=0.0):
+    """A per-worker shard whose perf origin sits at wall `origin`:
+    beacons at ts 0 and 2s (the second optionally wall-stepped by
+    `step` seconds, simulating an NTP jump mid-run)."""
+    evs = [_beacon(0.0, origin),
+           _beacon(2e6, origin + 2.0 + step)] + list(events)
+    evs.sort(key=lambda e: e["ts"])
+    doc = {"traceEvents": evs, "worker": worker,
+           "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def test_merge_aligns_skewed_shard_clocks(tmp_path):
+    """Two shards with wildly different perf origins: after the merge
+    the cross-worker event order matches wall time, each worker gets
+    its own pid track, and the failed-over job's slices are chained by
+    s/t/f flow events crossing the two tracks."""
+    tm = _tool("trace_merge")
+    # w0 booted at wall 1000.0, w1 at 1003.5: identical wall instants
+    # sit 3.5e6 us apart in shard-local timestamps
+    a = _write_shard(
+        str(tmp_path / "trace.w0.json"), "w0", 1000.0,
+        [_instant("route.trace.admit", 0.1e6, job_id="j1", tenant="t"),
+         _slice("j1", 0.2e6, worker="w0"),
+         _slice("solo", 0.3e6, worker="w0"),
+         _instant("route.trace.terminal", 0.35e6, job_id="solo",
+                  state="done")])
+    b = _write_shard(
+        str(tmp_path / "trace.w1.json"), "w1", 1003.5,
+        [_instant("route.fleet.lease.steal", 0.05e6, job_id="j1",
+                  stolen_from="w0", generation=2),
+         _slice("j1", 0.1e6, worker="w1", n=2),
+         _instant("route.trace.terminal", 0.15e6, job_id="j1",
+                  state="done")])
+    doc = tm.merge([a, b], skew_bound_ms=250.0)
+    meta = doc["traceMergeMeta"]
+    assert [s["worker"] for s in meta["shards"]] == ["w0", "w1"]
+    assert meta["residual_skew_ms"] < 1.0    # clean clocks
+    assert meta["skew_bound_ms"] == 250.0
+    pid_of = {s["worker"]: s["pid"] for s in meta["shards"]}
+    evs = doc["traceEvents"]
+    # one process_name track per worker
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {1: "worker w0", 2: "worker w1"}
+    # w1's slice (wall 1003.6) merged AFTER w0's (wall 1000.2)
+    slices = [e for e in evs if e.get("ph") == "X"
+              and e["args"]["job_id"] == "j1"]
+    assert [e["pid"] for e in sorted(slices, key=lambda e: e["ts"])] \
+        == [pid_of["w0"], pid_of["w1"]]
+    assert slices[1]["ts"] - slices[0]["ts"] == pytest.approx(
+        3.4e6, rel=1e-6)
+    # the flow chain: s on w0's span, f (with enclosing-slice binding)
+    # on w1's, same id — the visibly connected failover
+    flows = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+    assert [(e["ph"], e["pid"]) for e in
+            sorted(flows, key=lambda e: e["ts"])] \
+        == [("s", pid_of["w0"]), ("f", pid_of["w1"])]
+    assert len({e["id"] for e in flows}) == 1
+    assert all(e.get("bp") == "e" for e in flows if e["ph"] != "s")
+    # the single-slice job gets no flow events (already one chain)
+    assert not any(e["args"]["job_id"] == "solo" for e in flows)
+    # the merged doc is a valid trace for the report tool
+    tr = _tool("trace_report")
+    assert tr.validate(doc) == []
+    assert tr.check_counters(doc) == []
+
+
+def test_merge_rejects_beaconless_shard_and_cli(tmp_path):
+    tm = _tool("trace_merge")
+    bad = str(tmp_path / "trace.w9.json")
+    with open(bad, "w") as f:
+        json.dump({"traceEvents": [_slice("j", 1.0)]}, f)
+    with pytest.raises(ValueError, match="no route.trace.beacon"):
+        tm.merge([bad])
+    out = str(tmp_path / "merged.json")
+    assert tm.main([bad, "--out", out]) == 2
+    assert not os.path.exists(out)
+    # the happy-path CLI writes atomically and prints a summary
+    good = _write_shard(str(tmp_path / "trace.w0.json"), "w0",
+                        1000.0, [])
+    assert tm.main([good, "--out", out]) == 0
+    assert json.load(open(out))["traceMergeMeta"]["shards"][0][
+        "worker"] == "w0"
+
+
+def test_merge_reports_wall_step_as_residual_skew(tmp_path):
+    """A 1s wall-clock step between a shard's beacons spreads its
+    origin estimates by 1s: the merge must surface ~1000ms residual
+    skew, and the doctor must fail it against a 250ms bound."""
+    tm = _tool("trace_merge")
+    a = _write_shard(str(tmp_path / "trace.w0.json"), "w0", 1000.0, [])
+    b = _write_shard(str(tmp_path / "trace.w1.json"), "w1", 1000.0,
+                     [], step=1.0)
+    doc = tm.merge([a, b], skew_bound_ms=250.0)
+    skew = doc["traceMergeMeta"]["residual_skew_ms"]
+    assert skew == pytest.approx(1000.0, abs=1.0)
+    fd = _tool("flow_doctor")
+    errs, _ = fd.check_fleet_trace(doc)
+    assert any("residual clock skew" in e for e in errs)
+    # a bound that admits the step passes the skew rule
+    ok = tm.merge([a, b], skew_bound_ms=1500.0)
+    errs, _ = fd.check_fleet_trace(ok)
+    assert not any("residual clock skew" in e for e in errs)
+
+
+# ---- doctor rule set (crafted merged traces) -----------------------
+
+def _merged(events, skew=0.5, bound=250.0, shards=2):
+    return {"traceEvents": list(events),
+            "traceMergeMeta": {
+                "shards": [{"worker": f"w{i}", "pid": i + 1,
+                            "beacons": 2, "skew_ms": skew}
+                           for i in range(shards)],
+                "residual_skew_ms": skew, "skew_bound_ms": bound}}
+
+
+def _ev(ev, pid):
+    out = dict(ev)
+    out["pid"] = pid
+    return out
+
+
+def _healthy_failover_events():
+    return [
+        _ev(_instant("route.trace.submit", 0.0, job_id="j1"), 1),
+        _ev(_instant("route.trace.admit", 1.0, job_id="j1"), 1),
+        _ev(_slice("j1", 10.0, dur_us=5.0, worker="w0"), 1),
+        _ev(_instant("route.fleet.lease.steal", 20.0, job_id="j1",
+                     stolen_from="w0", generation=2), 2),
+        _ev(_slice("j1", 30.0, dur_us=5.0, n=2, worker="w1"), 2),
+        _ev(_instant("route.trace.terminal", 40.0, job_id="j1",
+                     state="done", slices=2), 2),
+    ]
+
+
+def test_doctor_fleet_trace_healthy_failover():
+    fd = _tool("flow_doctor")
+    errs, notes = fd.check_fleet_trace(
+        _merged(_healthy_failover_events()))
+    assert errs == []
+    assert any("1 cross-worker chain(s) (1 steal/failover-linked)"
+               in n for n in notes)
+
+
+def test_doctor_fleet_trace_orphan_and_disconnected():
+    fd = _tool("flow_doctor")
+    # slice spans whose job never closes: orphaned lifecycle
+    errs, _ = fd.check_fleet_trace(_merged([
+        _ev(_instant("route.trace.admit", 0.0, job_id="jx"), 1),
+        _ev(_slice("jx", 10.0, worker="w0"), 1)]))
+    assert any("orphaned lifecycle" in e for e in errs)
+    # two-track job without the steal/failover instant: disconnected
+    evs = [e for e in _healthy_failover_events()
+           if e["name"] != "route.fleet.lease.steal"]
+    errs, _ = fd.check_fleet_trace(_merged(evs))
+    assert any("disconnected failover chain" in e for e in errs)
+    # done without an origin or without slices
+    errs, _ = fd.check_fleet_trace(_merged([
+        _ev(_instant("route.trace.terminal", 5.0, job_id="jy",
+                     state="done"), 1)]))
+    assert any("no submit/admit" in e for e in errs)
+    assert any("no slice spans" in e for e in errs)
+
+
+def test_doctor_fleet_trace_verdict_codes_and_meta():
+    fd = _tool("flow_doctor")
+    errs, _ = fd.check_fleet_trace(_merged([
+        _ev(_instant("route.trace.shed", 1.0, job_id="js"), 1)]))
+    assert any("no machine-readable code" in e for e in errs)
+    errs, _ = fd.check_fleet_trace(_merged([
+        _ev(_instant("route.trace.reject", 1.0, job_id="jr",
+                     code="queue_full"), 1)]))
+    assert not any("machine-readable" in e for e in errs)
+    # not a merged trace at all
+    errs, _ = fd.check_fleet_trace({"traceEvents": []})
+    assert any("no traceMergeMeta" in e for e in errs)
+
+
+def test_doctor_cli_fleet_trace_flag(tmp_path):
+    import subprocess
+    import sys
+    healthy = str(tmp_path / "ok.json")
+    with open(healthy, "w") as f:
+        json.dump(_merged(_healthy_failover_events()), f)
+    orphan = str(tmp_path / "orphan.json")
+    with open(orphan, "w") as f:
+        json.dump(_merged([
+            _ev(_slice("lost", 10.0, worker="w0"), 1)]), f)
+    doctor = os.path.join(TOOLS, "flow_doctor.py")
+    ok = subprocess.run([sys.executable, doctor,
+                         "--fleet-trace", healthy],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stderr
+    bad = subprocess.run([sys.executable, doctor,
+                          "--fleet-trace", orphan],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "orphaned lifecycle" in bad.stderr
+
+
+# ---- trace_report: merged traces and empty tracks ------------------
+
+def test_report_flow_phases_and_per_pid_counters():
+    tr = _tool("trace_report")
+    doc = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 1, "ts": 0,
+         "args": {"name": "worker w0"}},
+        {"name": "process_name", "ph": "M", "pid": 2, "ts": 0,
+         "args": {"name": "worker w1"}},
+        _ev(_slice("j", 0.0, dur_us=5.0), 1),
+        {"name": "job:j", "ph": "s", "id": 7, "ts": 0.0, "pid": 1,
+         "tid": 1, "cat": "job"},
+        {"name": "q", "ph": "C", "ts": 1.0, "pid": 1, "tid": 1,
+         "args": {"value": 3.0}},
+        {"name": "q", "ph": "C", "ts": 2.0, "pid": 2, "tid": 1,
+         "args": {"value": 9.0}},
+        # pid 2's track restarts below pid 1's last sample: legal in a
+        # merged trace (per-(pid, name) monotonicity), and the flow
+        # f event needs only an id
+        {"name": "q", "ph": "C", "ts": 3.0, "pid": 1, "tid": 1,
+         "args": {"value": 4.0}},
+        {"name": "job:j", "ph": "f", "id": 7, "ts": 4.0, "pid": 2,
+         "tid": 1, "bp": "e", "cat": "job"},
+    ], "declaredCounterTracks": ["q", "route.never_sampled"]}
+    assert tr.validate(doc) == []
+    assert tr.check_counters(doc) == []
+    text = tr.summarize(doc)
+    assert "counter tracks [worker w0]" in text
+    assert "counter tracks [worker w1]" in text
+    assert "empty track" in text and "route.never_sampled" in text
+    # a flow event without its id IS malformed
+    bad = {"traceEvents": [
+        {"name": "job:j", "ph": "s", "ts": 0.0, "pid": 1, "tid": 1}]}
+    assert any("without 'id'" in e for e in tr.validate(bad))
+
+
+# ---- daemon lifecycle emission + telemetry plane -------------------
+
+def test_daemon_emits_lifecycle_shard_and_telemetry(tmp_path):
+    shard = str(tmp_path / "box" / "trace.solo.json")
+    set_tracer(Tracer(worker="solo"))
+    d, svc, clock = _mk_daemon(
+        tmp_path, opts=DaemonOpts(default_nets_per_s=10.0,
+                                  cold_start_factor=1.0,
+                                  exit_when_idle=1, trace_path=shard))
+    submit_job(d.inbox_dir, {"nets": 5, "name": "a"}, tenant="t0",
+               job_id="a")
+    jobs = d.run()
+    assert [j.state for j in jobs] == [JobState.DONE]
+    doc = json.load(open(shard))
+    assert doc["worker"] == "solo"
+    by_name = {}
+    for e in doc["traceEvents"]:
+        by_name.setdefault(e["name"], []).append(e)
+    assert len(by_name["route.trace.beacon"]) >= 2  # start + cycles
+    assert by_name["route.trace.submit"][0]["args"]["job_id"] == "a"
+    assert by_name["route.trace.submit"][0]["args"]["age_src"] == "mono"
+    assert by_name["route.trace.admit"][0]["args"]["tenant"] == "t0"
+    sl = by_name["route.trace.slice"][0]
+    assert sl["ph"] == "X" and sl["args"]["job_id"] == "a"
+    term = by_name["route.trace.terminal"][0]["args"]
+    assert term["job_id"] == "a" and term["state"] == "done"
+    v = get_metrics().values("route.")
+    assert v["route.daemon.inbox_lag_src"] == "mono"
+    assert v["route.trace.beacons"] >= 2
+    assert v["route.trace.shard_writes"] >= 1
+    assert v["route.trace.flight_records"] == d.recorder.total > 0
+    # the telemetry snapshot published next to the heartbeat
+    tele = json.load(open(os.path.join(d.inbox_dir, telemetry_name())))
+    assert tele["schema"] == 1 and tele["jobs"] == {"a": "done"}
+    assert tele["in_flight"]["job_id"] == "a"
+    assert tele["last_verdicts"][-1]["verdict"] == "done"
+    assert tele["metrics"]["route.daemon.admitted"] == 1
+    s = d.summary()
+    assert s["daemon"]["telemetry"]["flight_recorded"] > 0
+    assert s["trace"]["route.trace.shard_writes"] >= 1
+    # the shard is report-clean
+    tr = _tool("trace_report")
+    assert tr.validate(doc) == []
+
+
+def test_daemon_inbox_lag_wall_fallback_flagged(tmp_path):
+    d, svc, clock = _mk_daemon(tmp_path)
+    # explicit-ts submissions (replays) carry no monotonic twin: lag
+    # falls back to wall math against the daemon's wall clock
+    submit_job(d.inbox_dir, {"nets": 5, "name": "a"}, job_id="a",
+               ts=999.9)
+    d.run()
+    v = get_metrics().values("route.daemon.")
+    assert v["route.daemon.inbox_lag_s"] == pytest.approx(0.1)
+    assert v["route.daemon.inbox_lag_src"] == "wall"
+
+
+def test_trace_disabled_stays_noop(tmp_path):
+    d, svc, clock = _mk_daemon(tmp_path)
+    submit_job(d.inbox_dir, {"nets": 5, "name": "a"}, job_id="a")
+    jobs = d.run()
+    assert [j.state for j in jobs] == [JobState.DONE]
+    # no tracer: no shard, no beacons, no per-event cost — the only
+    # route.trace.* instrument is the always-on flight-recorder gauge
+    assert not [n for n in os.listdir(d.inbox_dir)
+                if n.startswith("trace.")]
+    v = get_metrics().values("route.trace.")
+    assert set(v) == {"route.trace.flight_records"}
+    # telemetry is independent of tracing and still published
+    assert os.path.exists(os.path.join(d.inbox_dir, telemetry_name()))
+
+
+def test_lease_steal_emits_linking_instant(tmp_path):
+    tr = Tracer(worker="w1")
+    set_tracer(tr)
+    c = _Clock()
+    mk = lambda w: LeaseStore(str(tmp_path), w, ttl_s=5.0, clock=c,
+                              wall=lambda: 1000.0 + c.t)
+    w0, w1 = mk("w0"), mk("w1")
+    assert w0.acquire("j")
+    c.t += 5.1
+    assert w1.steal("j")
+    w1.release("j", state="done")
+    evs = {e["name"]: e for e in tr.events}
+    steal = evs["route.fleet.lease.steal"]["args"]
+    assert steal["job_id"] == "j" and steal["stolen_from"] == "w0"
+    assert steal["generation"] == 2
+    assert evs["route.fleet.lease.acquire"]["args"]["worker"] == "w0"
+    assert evs["route.fleet.lease.release"]["args"]["state"] == "done"
+
+
+def test_metrics_endpoint_reads_snapshots_without_device_work(tmp_path):
+    box = str(tmp_path)
+    # one healthy snapshot, one torn/garbled, one mid-write .tmp: the
+    # scrape must serve the healthy one, count the garbled one, and
+    # never look at the .tmp
+    with open(os.path.join(box, telemetry_name("w0")), "w") as f:
+        json.dump({"schema": 1, "cycle": 3, "queue_depth": 1,
+                   "in_flight": {"job_id": "a", "slice": 2},
+                   "held_leases": ["a"], "draining": False}, f)
+    with open(os.path.join(box, telemetry_name("w1")), "w") as f:
+        f.write('{"torn": tru')
+    with open(os.path.join(box, telemetry_name("w2")) + ".tmp",
+              "w") as f:
+        f.write("{}")
+    srv = InboxHTTPServer(box).start()
+    try:
+        before = get_metrics().values("route.pipeline.")
+        with urlrequest.urlopen(srv.url + "/metrics", timeout=5) as r:
+            doc = json.loads(r.read().decode("utf-8"))
+        assert list(doc["workers"]) == ["w0"]
+        assert doc["workers"]["w0"]["cycle"] == 3
+        assert doc["transport"]["requests"] == 0  # scrapes aren't
+        #                                           submissions
+        # a scrape is pure file reads: no pipeline instrument (in
+        # particular no blocking_syncs) ever moves
+        assert get_metrics().values("route.pipeline.") == before == {}
+        v = get_metrics().values("route.fleet.")
+        assert v["route.fleet.metrics_scrapes"] == 1
+        assert v["route.fleet.telemetry_read_errors"] == 1
+        # /status stays the historical shape plus condensed liveness
+        with urlrequest.urlopen(srv.url + "/status", timeout=5) as r:
+            st = json.loads(r.read().decode("utf-8"))
+        assert st["requests"] == 0
+        assert st["workers"]["w0"]["in_flight"]["job_id"] == "a"
+    finally:
+        srv.stop()
+
+
+def test_flight_recorder_ring_bounds_and_diag_bundle(tmp_path):
+    rec = FlightRecorder(capacity=4, clock=lambda: 1.0,
+                         wall=lambda: 2.0)
+    for i in range(6):
+        rec.note("slice", job_id=f"j{i}")
+    snap = rec.snapshot()
+    assert snap["capacity"] == 4 and snap["recorded"] == 6
+    assert snap["dropped"] == 2
+    assert [e["job_id"] for e in snap["events"]] \
+        == ["j2", "j3", "j4", "j5"]
+    # the ring lands in the diag bundle of a terminally-failed job
+    from parallel_eda_tpu.resil import Resilience, ResilOpts
+    from parallel_eda_tpu.serve.service import RouteService
+    svc = RouteService.__new__(RouteService)
+    svc.resil = Resilience(
+        ResilOpts(checkpoint_dir=str(tmp_path / "diag")))
+    svc.flight = rec
+    svc.diag_extra = None
+    job = RouteJob(tenant="t0", payload=None, job_id="jx")
+    job.state = JobState.FAILED
+    job.error = "boom"
+    job.attempts = 1
+    path = svc._diag_bundle(job)
+    bundle = json.load(open(path))
+    assert bundle["flight_recorder"]["recorded"] == 6
+    assert bundle["flight_recorder"]["dropped"] == 2
+    assert [e["job_id"] for e in bundle["flight_recorder"]["events"]] \
+        == ["j2", "j3", "j4", "j5"]
+
+
+def test_shed_and_reject_carry_verdict_instants(tmp_path):
+    set_tracer(Tracer(worker="solo"))
+    opts = DaemonOpts(admit_horizon_s=5.0, default_nets_per_s=10.0,
+                      cold_start_factor=1.0, exit_when_idle=1)
+    d, svc, clock = _mk_daemon(tmp_path, opts=opts)
+    submit_job(d.inbox_dir, {"nets": 1000, "name": "big"},
+               job_id="big")
+    d.run()
+    tr = [e for e in get_tracer().events
+          if e["name"] == "route.trace.reject"]
+    assert tr and tr[0]["args"]["job_id"] == "big"
+    assert tr[0]["args"]["code"]

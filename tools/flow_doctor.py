@@ -54,6 +54,16 @@ Checks, each skipped (with a note) when its artifact is not given:
            released at shutdown (no orphaned work), no job completes
            twice across workers, and every job row names its worker —
            a fleet that fakes failover or leaks work is UNHEALTHY
+  fleet-trace  (--fleet-trace FILE) the MERGED fleet trace
+           (tools/trace_merge.py output): the clock-alignment residual
+           skew stays under the declared bound; every done job's
+           lifecycle is one contiguous chain (submit/admit -> slice
+           spans -> terminal instant, in order); slice spans with no
+           closing terminal/reject/shed instant are orphans; a job
+           whose slices cross >= 2 worker tracks must carry the
+           lease-steal (or failover) instant that links the break —
+           a failover the trace cannot connect never happened; every
+           reject/shed verdict instant names a machine-readable code
   lint     (--lint [--lint-root DIR]) the graft-lint static rule set
            (parallel_eda_tpu/analysis): donation safety, jit-signature
            drift, determinism, durable-write atomicity, metric-name
@@ -633,6 +643,156 @@ def check_fleet(doc: dict) -> tuple:
     return errs, notes
 
 
+def check_fleet_trace(doc: dict) -> tuple:
+    """Fleet-trace rule set over a MERGED trace (trace_merge.py
+    output).  Returns (errors, notes).  The rules hold the trace to
+    the story the fleet tells:
+
+      * residual clock skew (the spread of each shard's beacon-origin
+        estimates) stays under the bound the merge declared — beyond
+        it, cross-worker event ordering is untrustworthy and every
+        ordering rule below would be noise;
+      * every DONE job is one contiguous lifecycle chain: a
+        submit/admit origin, at least one slice span, a terminal
+        instant, in timeline order (modulo the skew bound);
+      * slice spans whose job never reached terminal/reject/shed are
+        orphans — work the trace shows starting but never accounts
+        for;
+      * a job whose slice spans sit on >= 2 worker tracks (a
+        failover) must carry the lease-steal or failover instant that
+        links the break — without it the chain is visibly
+        disconnected in Perfetto and unauditable here;
+      * reject/shed verdict instants must name a machine-readable
+        code, mirroring the daemon-summary rule at trace level.
+    """
+    errs, notes = [], []
+    meta = doc.get("traceMergeMeta")
+    if not isinstance(meta, dict):
+        return (["fleet-trace: no traceMergeMeta — not a merged "
+                 "fleet trace (run tools/trace_merge.py over the "
+                 "worker shards first)"], notes)
+    skew = meta.get("residual_skew_ms")
+    bound = meta.get("skew_bound_ms")
+    if not isinstance(skew, (int, float)):
+        errs.append("fleet-trace: traceMergeMeta.residual_skew_ms "
+                    "missing — the merge cannot vouch for cross-"
+                    "worker ordering")
+    elif isinstance(bound, (int, float)) and skew > bound:
+        errs.append(f"fleet-trace: residual clock skew {skew}ms "
+                    f"exceeds the declared {bound}ms bound — a wall-"
+                    f"clock step mid-run; cross-worker ordering is "
+                    f"untrustworthy")
+    slack_us = (bound if isinstance(bound, (int, float))
+                else 250.0) * 1e3
+
+    jobs: dict = {}
+
+    def bucket(jid):
+        return jobs.setdefault(jid, {"slices": [], "instants": {},
+                                     "steals": 0})
+
+    for e in doc.get("traceEvents", []):
+        if not isinstance(e, dict):
+            continue
+        name = e.get("name")
+        jid = (e.get("args") or {}).get("job_id")
+        if not isinstance(jid, str) or not jid \
+                or not isinstance(name, str):
+            continue
+        if e.get("ph") == "X" and name == "route.trace.slice":
+            bucket(jid)["slices"].append(e)
+        elif e.get("ph") == "i":
+            if name.startswith("route.trace."):
+                kind = name[len("route.trace."):]
+                bucket(jid)["instants"].setdefault(kind, []).append(e)
+            elif name == "route.fleet.lease.steal":
+                bucket(jid)["steals"] += 1
+    if not jobs:
+        errs.append("fleet-trace: no job-lifecycle events at all — "
+                    "tracing was off, or the shards predate the "
+                    "lifecycle instrumentation")
+
+    n_done = n_multi = n_linked = 0
+    for jid, b in sorted(jobs.items()):
+        ins = b["instants"]
+        for kind in ("reject", "shed"):
+            for e in ins.get(kind, []):
+                if not (e.get("args") or {}).get("code"):
+                    errs.append(f"fleet-trace: job {jid} {kind} "
+                                f"instant carries no machine-readable "
+                                f"code — a verdict with no reason")
+        closed = any(k in ins for k in ("terminal", "reject", "shed"))
+        if b["slices"] and not closed:
+            errs.append(f"fleet-trace: job {jid} has "
+                        f"{len(b['slices'])} slice span(s) but no "
+                        f"terminal/reject/shed instant — an orphaned "
+                        f"lifecycle the trace never closes")
+        term = ins.get("terminal", [])
+        done = any((e.get("args") or {}).get("state") == "done"
+                   for e in term)
+        if done:
+            n_done += 1
+            origin = [e.get("ts") for k in ("submit", "admit")
+                      for e in ins.get(k, [])
+                      if isinstance(e.get("ts"), (int, float))]
+            if not origin:
+                errs.append(f"fleet-trace: done job {jid} has no "
+                            f"submit/admit instant — a chain with no "
+                            f"origin")
+            if not b["slices"]:
+                errs.append(f"fleet-trace: done job {jid} has no "
+                            f"slice spans — it finished without ever "
+                            f"visibly running")
+            else:
+                starts = [e["ts"] for e in b["slices"]
+                          if isinstance(e.get("ts"), (int, float))]
+                ends = [e["ts"] + (e.get("dur") or 0.0)
+                        for e in b["slices"]
+                        if isinstance(e.get("ts"), (int, float))]
+                t_term = max((e.get("ts") for e in term
+                              if isinstance(e.get("ts"),
+                                            (int, float))),
+                             default=None)
+                if origin and starts \
+                        and min(starts) + slack_us < min(origin):
+                    errs.append(f"fleet-trace: done job {jid} sliced "
+                                f"before its submit/admit instant "
+                                f"(beyond the {bound}ms skew bound) — "
+                                f"the chain is out of order")
+                if t_term is not None and ends \
+                        and max(ends) > t_term + slack_us:
+                    errs.append(f"fleet-trace: done job {jid} has a "
+                                f"slice span ending after its "
+                                f"terminal instant (beyond the "
+                                f"{bound}ms skew bound) — the chain "
+                                f"is out of order")
+        span_pids = {e.get("pid") for e in b["slices"]} - {None}
+        if len(span_pids) >= 2:
+            n_multi += 1
+            if b["steals"] or ins.get("failover"):
+                n_linked += 1
+            else:
+                errs.append(f"fleet-trace: job {jid} sliced on "
+                            f"{len(span_pids)} worker tracks with no "
+                            f"lease-steal or failover instant linking "
+                            f"the break — a disconnected failover "
+                            f"chain")
+        elif b["steals"] and b["slices"]:
+            # the victim died before exporting a slice for this job:
+            # the steal is real but only one track shows work — worth
+            # eyes, not a failure
+            notes.append(f"fleet-trace: job {jid} lease was stolen "
+                         f"but all its slices sit on one worker track "
+                         f"(victim died before exporting a slice)")
+    shards = meta.get("shards") or []
+    notes.append(f"fleet-trace: {len(shards)} worker track(s), "
+                 f"{len(jobs)} job(s), {n_done} done, {n_multi} "
+                 f"cross-worker chain(s) ({n_linked} steal/failover-"
+                 f"linked), residual skew {skew}ms "
+                 f"(bound {bound}ms)")
+    return errs, notes
+
+
 def check_lint(root=None):
     """Run the graft-lint static rule set (parallel_eda_tpu/analysis —
     stdlib-only like this tool) over the source tree.  Every live
@@ -709,6 +869,12 @@ def main(argv=None) -> int:
                          "implies lease expiry, transport retries "
                          "bounded, no orphaned leases, exactly-once "
                          "completion, worker attribution)")
+    ap.add_argument("--fleet-trace", dest="fleet_trace",
+                    help="MERGED fleet trace JSON (trace_merge.py "
+                         "output) to gate with the fleet-trace rule "
+                         "set (skew bound, contiguous per-job "
+                         "lifecycle chains, steal-linked failovers, "
+                         "no orphaned slice spans, coded verdicts)")
     ap.add_argument("--lint", action="store_true",
                     help="run the graft-lint static rule set over the "
                          "source tree (donation safety, signature "
@@ -721,11 +887,11 @@ def main(argv=None) -> int:
 
     if not any((args.trace, args.metrics, args.devprof, args.row,
                 args.corpus, args.serve_summary, args.daemon_summary,
-                args.fleet_summary, args.lint)):
+                args.fleet_summary, args.fleet_trace, args.lint)):
         ap.error("nothing to check: give at least one of --trace / "
                  "--metrics / --devprof / --row / --corpus / "
                  "--serve-summary / --daemon-summary / "
-                 "--fleet-summary / --lint")
+                 "--fleet-summary / --fleet-trace / --lint")
 
     errs, notes = [], []
     try:
@@ -791,6 +957,10 @@ def main(argv=None) -> int:
             fe, fn = check_fleet(_read_json(args.fleet_summary))
             errs += fe
             notes += fn
+        if args.fleet_trace:
+            te, tn = check_fleet_trace(_read_json(args.fleet_trace))
+            errs += te
+            notes += tn
         if args.lint:
             le, ln = check_lint(args.lint_root)
             errs += le

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Merge per-worker trace shards into ONE fleet Perfetto timeline.
+
+Every fleet worker exports a private Chrome trace-event shard
+(``trace.<worker>.json``) whose timestamps sit on that process's own
+``perf_counter`` origin — mutually meaningless across processes.  Each
+shard also carries **clock-sync beacons**: instants named
+``route.trace.beacon`` whose args hold a wall-clock sample taken back
+to back with the shard timestamp.  Each beacon therefore estimates the
+shard's wall-clock origin as ``wall - ts``; the merge
+
+* aligns every shard onto one shared timeline using the median beacon
+  origin (robust to a single stepped sample),
+* reports the per-shard **residual skew** — the spread of the beacon
+  origin estimates, which bounds the post-align cross-worker timestamp
+  error (a wall-clock step mid-run widens it; ``flow_doctor
+  --fleet-trace`` gates it against the declared bound),
+* assigns one Perfetto pid (process track) per worker with a proper
+  ``process_name`` metadata record,
+* and connects each job's lifecycle spans into one **flow** (``s``/
+  ``t``/``f`` events keyed by a stable job-id hash), so a SIGKILL
+  failover renders as a visibly connected chain crossing two worker
+  tracks, with the ``route.fleet.lease.steal`` instant sitting at the
+  break.
+
+Stdlib only — this runs inside the fleet supervisor (which never
+imports jax) and in CI.
+
+    python tools/trace_merge.py --out box/trace.merged.json \
+        box/trace.w0.json box/trace.w1.json
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import statistics
+import sys
+
+BEACON_NAME = "route.trace.beacon"
+#: lifecycle span names whose per-job sequence becomes one flow
+FLOW_SPAN_NAMES = ("route.trace.slice",)
+
+
+def load_shard(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) \
+            or not isinstance(doc.get("traceEvents"), list):
+        raise ValueError(f"{path}: not a trace-event document")
+    return doc
+
+
+def shard_worker(path: str, doc: dict, index: int) -> str:
+    w = doc.get("worker")
+    if isinstance(w, str) and w:
+        return w
+    base = os.path.basename(path)
+    if base.startswith("trace.") and base.endswith(".json"):
+        mid = base[len("trace."):-len(".json")]
+        if mid:
+            return mid
+    return f"shard{index}"
+
+
+def beacon_origins(doc: dict) -> list:
+    """Per-beacon estimates of this shard's wall-clock origin
+    (seconds): ``wall - ts``.  With a stable wall clock these agree to
+    sampling jitter; a step between beacons shows up as spread."""
+    out = []
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "i" or ev.get("name") != BEACON_NAME:
+            continue
+        wall = (ev.get("args") or {}).get("wall")
+        ts = ev.get("ts")
+        if isinstance(wall, (int, float)) \
+                and isinstance(ts, (int, float)):
+            out.append(float(wall) - float(ts) / 1e6)
+    return out
+
+
+def _flow_id(job_id: str) -> int:
+    return int.from_bytes(
+        hashlib.sha1(job_id.encode("utf-8")).digest()[:6], "big")
+
+
+def _job_flows(events: list) -> list:
+    """Flow events connecting each job's lifecycle spans in merged-
+    timeline order.  A flow event binds to the slice enclosing its
+    (pid, tid, ts) — "bp": "e" pins the binding to the ENCLOSING
+    slice, not the next one — so anchoring at the span's own start ts
+    draws the arrow from/to that span."""
+    per_job = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") not in FLOW_SPAN_NAMES:
+            continue
+        job_id = (ev.get("args") or {}).get("job_id")
+        if not isinstance(job_id, str) or not job_id:
+            continue
+        per_job.setdefault(job_id, []).append(ev)
+    flows = []
+    for job_id, spans in sorted(per_job.items()):
+        if len(spans) < 2:
+            continue   # a single span is already one connected chain
+        spans.sort(key=lambda e: e["ts"])
+        fid = _flow_id(job_id)
+        last = len(spans) - 1
+        for i, sp in enumerate(spans):
+            ph = "s" if i == 0 else ("f" if i == last else "t")
+            ev = {"name": f"job:{job_id}", "cat": "job", "ph": ph,
+                  "id": fid, "ts": sp["ts"], "pid": sp["pid"],
+                  "tid": sp["tid"], "args": {"job_id": job_id}}
+            if ph != "s":
+                ev["bp"] = "e"
+            flows.append(ev)
+    return flows
+
+
+def merge(paths: list, skew_bound_ms: float = 250.0) -> dict:
+    """Beacon-align the shards at ``paths`` into one trace document.
+    Raises ValueError for an unalignable shard (no beacons) — a fleet
+    worker always emits its start-of-life beacon, so that means the
+    file is not a worker shard at all."""
+    shards = []
+    for i, path in enumerate(sorted(paths)):
+        doc = load_shard(path)
+        origins = beacon_origins(doc)
+        if not origins:
+            raise ValueError(
+                f"{path}: no {BEACON_NAME} events — cannot align this "
+                f"shard's clock origin")
+        shards.append({
+            "file": path,
+            "worker": shard_worker(path, doc, i),
+            "doc": doc,
+            "origins": origins,
+            "origin": statistics.median(origins),
+            "skew_ms": (max(origins) - min(origins)) * 1e3,
+        })
+    shards.sort(key=lambda s: s["worker"])
+    t0 = min(s["origin"] for s in shards)
+    events, meta_events, tracks = [], [], set()
+    shard_meta = []
+    for pid, s in enumerate(shards, start=1):
+        shift_us = (s["origin"] - t0) * 1e6
+        meta_events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "ts": 0,
+            "args": {"name": f"worker {s['worker']}"}})
+        meta_events.append({
+            "name": "process_sort_index", "ph": "M", "pid": pid,
+            "ts": 0, "args": {"sort_index": pid}})
+        for ev in s["doc"]["traceEvents"]:
+            if ev.get("ph") == "M":
+                continue   # per-shard metadata replaced above
+            ev = dict(ev)
+            ev["pid"] = pid
+            ev["ts"] = float(ev.get("ts", 0.0)) + shift_us
+            events.append(ev)
+        tracks.update(s["doc"].get("declaredCounterTracks") or [])
+        shard_meta.append({
+            "file": s["file"], "worker": s["worker"], "pid": pid,
+            "origin_wall": round(s["origin"], 6),
+            "beacons": len(s["origins"]),
+            "skew_ms": round(s["skew_ms"], 3)})
+    events.extend(_job_flows(events))
+    events.sort(key=lambda e: e["ts"])
+    residual = max(s["skew_ms"] for s in shard_meta)
+    doc = {"traceEvents": meta_events + events,
+           "displayTimeUnit": "ms",
+           "traceMergeMeta": {
+               "shards": shard_meta,
+               "residual_skew_ms": round(residual, 3),
+               "skew_bound_ms": float(skew_bound_ms)}}
+    if tracks:
+        doc["declaredCounterTracks"] = sorted(tracks)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="beacon-align per-worker trace shards into one "
+                    "Perfetto timeline")
+    ap.add_argument("shards", nargs="+",
+                    help="per-worker trace.<worker>.json files")
+    ap.add_argument("--out", required=True,
+                    help="merged trace output path")
+    ap.add_argument("--skew_bound_ms", type=float, default=250.0,
+                    help="declared residual-skew bound recorded in "
+                    "traceMergeMeta (flow_doctor --fleet-trace gates "
+                    "the observed skew against it)")
+    args = ap.parse_args(argv)
+    try:
+        doc = merge(args.shards, skew_bound_ms=args.skew_bound_ms)
+    except (OSError, ValueError) as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, args.out)
+    meta = doc["traceMergeMeta"]
+    print(json.dumps({
+        "out": args.out,
+        "shards": [s["worker"] for s in meta["shards"]],
+        "events": len(doc["traceEvents"]),
+        "residual_skew_ms": meta["residual_skew_ms"],
+        "skew_bound_ms": meta["skew_bound_ms"]}, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

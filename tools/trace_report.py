@@ -78,6 +78,11 @@ def validate(doc) -> list:
                 errs.append(f"event {i}: E without matching B on {key}")
             else:
                 open_begins[key] -= 1
+        elif ph in ("s", "t", "f"):
+            # flow events (trace_merge connects a job's spans across
+            # worker tracks); the id is what ties one flow together
+            if "id" not in ev:
+                errs.append(f"event {i}: flow event ({ph}) without 'id'")
         elif ph not in ("i", "I", "C"):
             errs.append(f"event {i}: unsupported phase {ph!r}")
     for key, depth in open_begins.items():
@@ -192,6 +197,19 @@ def _counters(doc):
             if isinstance(e, dict) and e.get("ph") == "C"]
 
 
+def _pid_names(doc):
+    """pid -> process_name from "M" metadata (one per worker in a
+    merged fleet trace)."""
+    out = {}
+    for e in doc.get("traceEvents", []):
+        if isinstance(e, dict) and e.get("ph") == "M" \
+                and e.get("name") == "process_name":
+            name = (e.get("args") or {}).get("name")
+            if isinstance(name, str):
+                out[e.get("pid")] = name
+    return out
+
+
 def check_counters(doc) -> list:
     """Counter-track ("C" event) invariants for --check:
 
@@ -203,7 +221,9 @@ def check_counters(doc) -> list:
       and would render as a detached track.
     - per-track ts must be non-decreasing — counters are appended from
       metrics snapshots in wall order; a regression means two tracers'
-      events were merged or the clock origin moved mid-run.
+      events were merged or the clock origin moved mid-run.  Tracks
+      are keyed per (pid, name): a merged fleet trace carries one
+      track per worker process, each independently monotone.
     """
     cs = _counters(doc)
     if not cs:
@@ -228,11 +248,13 @@ def check_counters(doc) -> list:
                 f"counter '{name}': ts {ts:.0f}us outside the span "
                 f"clock envelope [0, {envelope:.0f}]us — sample is off "
                 f"the tracer's clock origin")
-        prev = last_by_name.get(name)
+        key = (ev.get("pid"), name)
+        prev = last_by_name.get(key)
         if prev is not None and ts < prev:
-            errs.append(f"counter '{name}': ts {ts:.0f}us < previous "
-                        f"sample {prev:.0f}us (track not monotone)")
-        last_by_name[name] = ts
+            errs.append(f"counter '{name}' (pid {ev.get('pid')}): ts "
+                        f"{ts:.0f}us < previous sample {prev:.0f}us "
+                        f"(track not monotone)")
+        last_by_name[key] = ts
     return errs
 
 
@@ -304,16 +326,38 @@ def summarize(doc) -> str:
             f"{ov['plan_spans']} plan spans)")
 
     cs = _counters(doc)
+    declared = doc.get("declaredCounterTracks")
     if cs:
-        by_name = {}
+        by_pid = {}
         for e in cs:
             v = e.get("args", {}).get("value")
             if isinstance(v, (int, float)) and not isinstance(v, bool):
-                by_name.setdefault(e.get("name", "?"), []).append(v)
-        parts = [f"{n} [{min(vs):g}..{max(vs):g}] x{len(vs)}"
-                 for n, vs in sorted(by_name.items())]
-        lines.append(f"counter tracks: {len(by_name)} track(s), "
-                     f"{len(cs)} samples: " + ", ".join(parts))
+                by_pid.setdefault(e.get("pid"), {}) \
+                    .setdefault(e.get("name", "?"), []).append(v)
+        pid_names = _pid_names(doc)
+        # a merged fleet trace has one process (pid) per worker: group
+        # the tracks per worker so same-named counters don't interleave
+        for pid in sorted(by_pid, key=lambda p: (str(type(p)), str(p))):
+            by_name = by_pid[pid]
+            n_samp = sum(len(vs) for vs in by_name.values())
+            who = f" [{pid_names.get(pid, f'pid {pid}')}]" \
+                if len(by_pid) > 1 else ""
+            parts = [f"{n} [{min(vs):g}..{max(vs):g}] x{len(vs)}"
+                     for n, vs in sorted(by_name.items())]
+            lines.append(f"counter tracks{who}: {len(by_name)} "
+                         f"track(s), {n_samp} samples: "
+                         + ", ".join(parts))
+    if isinstance(declared, list) and declared:
+        sampled = set()
+        for e in cs:
+            sampled.add(e.get("name"))
+        empty = sorted(str(n) for n in declared if n not in sampled)
+        if empty:
+            # declared-but-unsampled is informational, not an error:
+            # the counter simply never moved during this run
+            lines.append(f"  note: {len(empty)} declared counter "
+                         f"track(s) with no samples (empty track): "
+                         + ", ".join(empty))
 
     compile_us = sum(e["dur"] for e in evs
                      if e.get("cat") == "jax.compile")

@@ -111,12 +111,19 @@ def main(argv=None) -> int:
                                  max_attempts=args.retries)
     else:
         from parallel_eda_tpu.serve.daemon import submit_job
-    submitted, per_tenant = [], {}
+    submitted, per_tenant, submit_walls = [], {}, {}
     t0 = time.perf_counter()
     for job in stream:
         if job["gap_s"]:
             time.sleep(job["gap_s"])
+        # trace context: the origin instant of this job's distributed
+        # lifecycle chain (the daemon stamps its submit instant from
+        # it; trace_merge connects everything downstream)
+        wall = round(time.time(), 6)
         if client is not None:
+            # TransportClient stamps its own trace context into the
+            # idempotent payload; record the same wall here so the
+            # summary and the trace agree on the origin
             job_id = client.submit(
                 job["spec"], tenant=job["tenant"],
                 priority=job["priority"],
@@ -127,13 +134,16 @@ def main(argv=None) -> int:
                 args.inbox, job["spec"], tenant=job["tenant"],
                 priority=job["priority"],
                 deadline_s=job.get("deadline_s"),
-                job_id=job["job_id"])
+                job_id=job["job_id"],
+                trace={"submit_wall": wall, "client": "traffic_gen"})
         submitted.append(job_id)
+        submit_walls[job_id] = wall
         per_tenant[job["tenant"]] = per_tenant.get(job["tenant"], 0) + 1
     print(json.dumps({
         "target": url or args.inbox,
         "seed": args.seed,
         "submitted": submitted,
+        "submit_walls": submit_walls,
         "per_tenant": per_tenant,
         "transport_retries": client.retries if client else 0,
         "wall_s": round(time.perf_counter() - t0, 3),
