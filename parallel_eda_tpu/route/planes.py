@@ -1846,6 +1846,52 @@ FUSED_WINDOW_STATIC_ARGNAMES = tuple(
                  "crop_tile")) + ("rung_desc",)
 
 
+def _fused_ladder(
+        pg: PlanesGraph, dev: DeviceRRGraph, occ, acc,
+        paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
+        sel_plans, valid_plans, full_bb,
+        pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+        K_iters: int, max_len: int, rung_desc, topk: int,
+        n_colors: int, mesh, tdev, req_seed, sta_depth: int,
+        crit_exp: float, max_crit: float, use_sdc: bool,
+        use_pallas: bool, bb0_all, widen_oks,
+        pallas_g1: bool, plane_dtype: str):
+    """The traced body shared by route_window_planes_fused (one job)
+    and route_window_planes_multi (one job per co-admitted tenant):
+    walk the ragged ``rung_desc`` descriptor table, threading the
+    negotiation state rung to rung exactly as the host per-rung loop
+    does.  See route_window_planes_fused for the full contract."""
+    if widen_oks is None:
+        widen_oks = (None,) * len(rung_desc)
+    out = None
+    scals = []
+    for r, (crop_tile, nsweeps, num_waves, group,
+            doubling) in enumerate(rung_desc):
+        out = _window_body(
+            pg, dev, occ, acc, paths, sink_delay, all_reached, bb,
+            source_all, sinks_all, crit_all,
+            opin_node_all, entry_cell_all, entry_oidx_all,
+            entry_delay_all, sink_uid_all, uid_cell, uid_ipin,
+            uid_delay, direct_oidx_all, direct_ipin_all,
+            direct_delay_all,
+            sel_plans[r], valid_plans[r], full_bb,
+            pres0, pres_mult, max_pres,
+            acc_fac if r == 0 else jnp.float32(0.0),
+            it0, force_until,
+            K_iters, nsweeps, max_len, num_waves, group, doubling,
+            topk, n_colors, mesh, tdev, req_seed, sta_depth, crit_exp,
+            max_crit, use_sdc, use_pallas, crop_tile, bb0_all,
+            widen_oks[r], pallas_g1, plane_dtype)
+        (occ, acc, paths, sink_delay, all_reached, bb) = out[:6]
+        crit_all = out[13]
+        scals.append(out[22])
+    return out + (jnp.stack(scals),)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=FUSED_WINDOW_STATIC_ARGNAMES,
@@ -1889,31 +1935,74 @@ def route_window_planes_fused(
     loop consumes) plus a stacked [n_rungs, SCAL_LEN] int32 of every
     rung's ``scal`` vector as a 24th element — the per-rung ledger rows
     _book_window would otherwise have collected per dispatch."""
-    if widen_oks is None:
-        widen_oks = (None,) * len(rung_desc)
-    out = None
-    scals = []
-    for r, (crop_tile, nsweeps, num_waves, group,
-            doubling) in enumerate(rung_desc):
-        out = _window_body(
+    return _fused_ladder(
+        pg, dev, occ, acc, paths, sink_delay, all_reached, bb,
+        source_all, sinks_all, crit_all,
+        opin_node_all, entry_cell_all, entry_oidx_all, entry_delay_all,
+        sink_uid_all, uid_cell, uid_ipin, uid_delay,
+        direct_oidx_all, direct_ipin_all, direct_delay_all,
+        sel_plans, valid_plans, full_bb,
+        pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+        K_iters, max_len, rung_desc, topk, n_colors, mesh, tdev,
+        req_seed, sta_depth, crit_exp, max_crit, use_sdc, use_pallas,
+        bb0_all, widen_oks, pallas_g1, plane_dtype)
+
+
+# the multi-job program's static argnames: one (K_iters, max_len,
+# rung_desc) triple per co-admitted job rides the ``job_statics``
+# descriptor, everything else is shared grid-level configuration
+MULTI_WINDOW_STATIC_ARGNAMES = ("job_statics", "n_colors",
+                                "use_pallas", "pallas_g1",
+                                "plane_dtype")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=MULTI_WINDOW_STATIC_ARGNAMES,
+    donate_argnames=("job_states",))
+def route_window_planes_multi(
+        pg: PlanesGraph, dev: DeviceRRGraph, job_states, job_dynamics,
+        job_statics=(), n_colors: int = 5,
+        use_pallas: bool = False, pallas_g1: bool = False,
+        plane_dtype: str = "f32"):
+    """Continuous-batching window dispatch: the fused window ladders of
+    EVERY co-admitted job as ONE device program on the shared device
+    graph.  Each job keeps its own donated negotiation state
+    (``job_states[j]`` = (occ, acc, paths, sink_delay, all_reached, bb,
+    crit_all)), its own terminals/plan tensors (``job_dynamics[j]`` =
+    (source_all, sinks_all, tables[11], sel_plans, valid_plans,
+    full_bb, pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+    bb0_all, widen_oks)) and its own static descriptor
+    (``job_statics[j]`` = (K_iters, max_len, rung_desc, topk) — topk
+    is per job because it tracks each job's net count, and a tiny job
+    must fuse with a full-size one), so every
+    job's ladder traces into an INDEPENDENT subgraph of the one XLA
+    program — per-job results are bit-identical to dispatching each
+    job's route_window_planes_fused alone, by construction, while the
+    scheduler overlaps all jobs' lane-starved windows on the device.
+
+    Single-device only (no mesh sharding, no device-resident STA): the
+    serve layer falls back to per-job solo dispatch for those modes.
+
+    Returns a tuple over jobs of route_window_planes_fused's 24-tuple,
+    in ``job_states`` order — the caller demuxes occ/paths/wirelength
+    strictly per job."""
+    outs = []
+    for st, dyn, (K_iters, max_len, rung_desc, topk) in zip(
+            job_states, job_dynamics, job_statics):
+        occ, acc, paths, sink_delay, all_reached, bb, crit_all = st
+        (source_all, sinks_all, tables, sel_plans, valid_plans,
+         full_bb, pres0, pres_mult, max_pres, acc_fac, it0,
+         force_until, bb0_all, widen_oks) = dyn
+        outs.append(_fused_ladder(
             pg, dev, occ, acc, paths, sink_delay, all_reached, bb,
-            source_all, sinks_all, crit_all,
-            opin_node_all, entry_cell_all, entry_oidx_all,
-            entry_delay_all, sink_uid_all, uid_cell, uid_ipin,
-            uid_delay, direct_oidx_all, direct_ipin_all,
-            direct_delay_all,
-            sel_plans[r], valid_plans[r], full_bb,
-            pres0, pres_mult, max_pres,
-            acc_fac if r == 0 else jnp.float32(0.0),
-            it0, force_until,
-            K_iters, nsweeps, max_len, num_waves, group, doubling,
-            topk, n_colors, mesh, tdev, req_seed, sta_depth, crit_exp,
-            max_crit, use_sdc, use_pallas, crop_tile, bb0_all,
-            widen_oks[r], pallas_g1, plane_dtype)
-        (occ, acc, paths, sink_delay, all_reached, bb) = out[:6]
-        crit_all = out[13]
-        scals.append(out[22])
-    return out + (jnp.stack(scals),)
+            source_all, sinks_all, crit_all, *tables,
+            sel_plans, valid_plans, full_bb,
+            pres0, pres_mult, max_pres, acc_fac, it0, force_until,
+            K_iters, max_len, rung_desc, topk, n_colors, None, None,
+            None, 0, 1.0, 0.99, False, use_pallas, bb0_all, widen_oks,
+            pallas_g1, plane_dtype))
+    return tuple(outs)
 
 
 try:
@@ -1924,6 +2013,8 @@ try:
     route_window_planes_fused._static_argnames = \
         FUSED_WINDOW_STATIC_ARGNAMES
     route_window_planes._static_argnames = WINDOW_STATIC_ARGNAMES
+    route_window_planes_multi._static_argnames = \
+        MULTI_WINDOW_STATIC_ARGNAMES
 except (AttributeError, TypeError):          # pragma: no cover
     pass
 

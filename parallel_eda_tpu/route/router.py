@@ -570,6 +570,30 @@ def _note_dispatch_variant(key) -> bool:
     return True
 
 
+class WindowDispatchRequest:
+    """One planned fused-window dispatch, externalized by the
+    generator-mode driver (Router.route_gen): the canonical variant
+    key, the positional/keyword args of
+    planes.route_window_planes_fused, the planned per-rung fallback
+    chain and the resilience runtime — everything
+    Router._exec_window_request needs to issue the dispatch.  The
+    serve layer's continuous batcher (serve/fused.py) merges
+    co-admitted jobs' requests into ONE route_window_planes_multi
+    program per lockstep step; the solo driver executes them one at a
+    time — either way the 24-tuple result is sent back into the
+    yielding generator unchanged, so per-job results are bit-identical
+    by construction."""
+    __slots__ = ("vkey", "f_args", "f_kwargs", "per_rung_fb",
+                 "resil_rt")
+
+    def __init__(self, vkey, f_args, f_kwargs, per_rung_fb, resil_rt):
+        self.vkey = vkey
+        self.f_args = f_args
+        self.f_kwargs = f_kwargs
+        self.per_rung_fb = per_rung_fb
+        self.resil_rt = resil_rt
+
+
 # bf16 shadow-oracle acceptance band (RouterOpts.dtype_guard): the
 # fraction of per-net status words allowed to disagree with the f32
 # oracle, and the relative tolerance on the scalar congestion summary
@@ -700,6 +724,12 @@ class Router:
         # uploads) + persistent compile cache, both for the pipelined
         # window driver
         self._staging = _PlanStaging()
+        # staging-slot namespace: the serve layer's continuous batcher
+        # drives several jobs' window generators against ONE router, so
+        # it prefixes each job's slot names (sel0/valid0/...) with the
+        # job id — without this, interleaved jobs would alias each
+        # other's slots and lose every hash-skip (correct, just slow)
+        self._staging_prefix = ""
         self._cap_np = None    # host capacity copy for congestion top-k
         if self.opts.compile_cache_dir:
             enable_persistent_compile_cache(self.opts.compile_cache_dir)
@@ -829,6 +859,38 @@ class Router:
         rungs.append(Rung("fused", run_fused))
         rungs.append(Rung("per_rung", per_rung_fb))
         return resil_rt.guard.run(vkey, rungs)
+
+    def _exec_window_request(self, req: WindowDispatchRequest):
+        """Issue ONE externalized fused-window dispatch: exactly the
+        guarded / AOT-library / live-jit chain the inline driver used
+        before the generator refactor, now behind the yield boundary —
+        the solo driver (_drive_windows) and the serve batcher's
+        per-job fallback both come through here, so a job dispatched
+        alone is bit-identical to the pre-generator code path."""
+        from .planes import route_window_planes_fused
+        resil_rt = req.resil_rt
+        if resil_rt is not None and resil_rt.guard is not None:
+            return self._guarded_dispatch_fused(
+                resil_rt, req.vkey, req.f_args, req.f_kwargs,
+                req.per_rung_fb)
+        _note_dispatch_variant(req.vkey)
+        if self._library is not None:
+            return self._library.dispatch(
+                req.vkey, route_window_planes_fused, req.f_args,
+                req.f_kwargs)
+        return route_window_planes_fused(*req.f_args, **req.f_kwargs)
+
+    def _drive_windows(self, gen) -> "RouteResult":
+        """Trivial solo executor over a window-dispatch generator
+        (route_gen): every yielded WindowDispatchRequest is issued
+        immediately and its 24-tuple sent back in — behavior-identical
+        to the pre-generator inline dispatch."""
+        try:
+            req = next(gen)
+            while True:
+                req = gen.send(self._exec_window_request(req))
+        except StopIteration as e:
+            return e.value
 
     @staticmethod
     def _dump_routes(stats_dir: str, it: int, paths: np.ndarray,
@@ -1439,8 +1501,10 @@ class Router:
                       [(len(s), t) for s, t in dispatch],
                       "crop_full", crop_full, flush=True)
 
+            stg = self._staging_prefix
             widen_d = (None if opts.sweep_budget_div <= 1
-                       else self._staging.put("widen", budget_full))
+                       else self._staging.put(stg + "widen",
+                                              budget_full))
 
             # per-window dtype/dispatch resolution (re-checked every
             # window: a mid-route demotion or a service-side ladder
@@ -1528,7 +1592,7 @@ class Router:
                     # would burn a pointless promotion round trip)
                     wok_np = budget_full.copy()
                     wok_np[sub[spans_full <= nsw]] = True
-                    wok = self._staging.put(f"wok{ri}", wok_np)
+                    wok = self._staging.put(f"{stg}wok{ri}", wok_np)
                 maxfan = int(nsinks_np[sub].max()) if len(sub) else 1
                 doubling = opts.sink_group == 0 and not precise
                 grp_w = 1 if precise and opts.sink_group == 0 else grp
@@ -1546,8 +1610,8 @@ class Router:
                 # reuse the staged device buffer outright, and fresh
                 # ones go up with a non-blocking device_put while the
                 # previous rung still executes
-                sel_d = self._staging.put(f"sel{ri}", sel_p)
-                valid_d = self._staging.put(f"valid{ri}", valid_p)
+                sel_d = self._staging.put(f"{stg}sel{ri}", sel_p)
+                valid_d = self._staging.put(f"{stg}valid{ri}", valid_p)
                 # ledger: filled batch slots, plan width, and real
                 # (non-pad) batch rows of this planned dispatch
                 return dict(tile=tile, nsw=nsw, waves=waves,
@@ -1739,19 +1803,13 @@ class Router:
                         scals.append(o2[22])
                     return o2 + (jnp.stack(scals),)
 
-                if resil_rt is not None and resil_rt.guard is not None:
-                    out24 = self._guarded_dispatch_fused(
-                        resil_rt, vkey, f_args, f_kwargs,
-                        run_per_rung_fb)
-                elif self._library is not None:
-                    _note_dispatch_variant(vkey)
-                    out24 = self._library.dispatch(
-                        vkey, route_window_planes_fused, f_args,
-                        f_kwargs)
-                else:
-                    _note_dispatch_variant(vkey)
-                    out24 = route_window_planes_fused(*f_args,
-                                                      **f_kwargs)
+                # externalized dispatch: the driver — route()'s solo
+                # loop, or the serve batcher merging co-admitted jobs
+                # into one multi-job program — issues the request and
+                # sends the 24-tuple back in (_exec_window_request
+                # holds the old guarded/AOT/jit dispatch chain)
+                out24 = yield WindowDispatchRequest(
+                    vkey, f_args, f_kwargs, run_per_rung_fb, resil_rt)
                 o = tuple(out24[:23])
                 retire.append((occ, acc, paths, sink_delay,
                                all_reached, bb, crit_d))
@@ -2235,6 +2293,151 @@ class Router:
                 dp.dump(os.path.join(opts.stats_dir, "devprof.json"))
         return result
 
+    def _planes_terminals(self, term):
+        """Device entry tables for ``term`` (planes.PlanesTerminals),
+        cached on id(term) across route() calls on the same terminals
+        — the tunnel uploads them once and they stay device-resident."""
+        if getattr(self, "_pt_key", None) != id(term):
+            from .planes import build_planes_terminals
+            pt = build_planes_terminals(
+                self.rr, term.source, term.sinks,
+                np.asarray(self.pg.cell_of_node), self.pg.ncells)
+            self._pt = tuple(jnp.asarray(a) for a in (
+                pt.opin_node, pt.entry_cell, pt.entry_oidx,
+                pt.entry_delay, pt.sink_uid, pt.uid_cell,
+                pt.uid_ipin, pt.uid_delay, pt.direct_oidx,
+                pt.direct_ipin, pt.direct_delay))
+            self._pt_key = id(term)
+            self._pt_ref = term          # keep id(term) alive
+        return self._pt
+
+    def route_gen(self, term: NetTerminals,
+                  crit: Optional[np.ndarray] = None,
+                  timing_cb: Optional[
+                      Callable[["RouteResult"], np.ndarray]] = None,
+                  analyzer=None,
+                  resume: Optional[RouteCheckpoint] = None):
+        """Generator-mode entry for the planes program: performs
+        route()'s device-state setup, then runs the window loop as a
+        generator that YIELDS a WindowDispatchRequest at every fused
+        window dispatch and expects the 24-tuple result sent back in.
+        ``route()`` drives it with the trivial solo loop
+        (_drive_windows) for exactly the historical behavior; the
+        serve layer's continuous batcher (serve/fused.py) instead
+        drives many jobs' generators in lockstep, merging concurrent
+        requests into one multi-job program.  The StopIteration value
+        is the RouteResult.
+
+        Setup runs lazily at the FIRST next(): callers co-driving
+        several jobs must set ``self.opts`` (and ``_staging_prefix``)
+        for the owning job before EVERY advance — the generator reads
+        router state mid-step (opts, staging, plan caches)."""
+        if self.pg is None:
+            raise ValueError(
+                "route_gen is supported by the planes program")
+        opts = self.opts
+        # multi-route safety (the serve loop calls route() many times
+        # on one process): re-assert THIS router's persistent compile
+        # cache dir — another Router built since may have pointed the
+        # process-global cache elsewhere (no-op when unchanged) — and
+        # zero the per-route pipeline gauges so a job that never
+        # reaches a given gauge doesn't inherit the previous job's
+        # value.  The dispatch-variant seen-set is process state on
+        # purpose and is NOT reset: warm variants stay warm.
+        if opts.compile_cache_dir:
+            enable_persistent_compile_cache(opts.compile_cache_dir)
+        get_metrics().set_gauges({k: 0.0 for k in (
+            "route.pipeline.host_plan_ms",
+            "route.pipeline.device_exec_ms",
+            "route.pipeline.stall_ms",
+            "route.pipeline.overlap_frac",
+            "route.pipeline.host_overlap_frac",
+            "route.pipeline.host_plan_ms_total",
+            "route.pipeline.device_exec_ms_total",
+            "route.pipeline.stall_ms_total",
+            "route.pipeline.host_serial_ms_total",
+        )})
+        # normalized into a LOCAL — never mutate the caller's
+        # RouterOpts (the same opts object may drive several routers,
+        # and the caller may compare it against what it passed in)
+        crop = normalize_crop(opts.crop)
+        rr = self.rr
+        R, Smax = term.sinks.shape
+        N = rr.num_nodes
+        B = min(opts.batch_size, max(1, R))
+        if self.mesh is not None and B % self._net_axis:
+            # batch must tile the net axis evenly
+            B = ((B + self._net_axis - 1)
+                 // self._net_axis) * self._net_axis
+        if crit is None:
+            crit = np.zeros((R, Smax), dtype=np.float32)
+        else:
+            # max_criticality clamp (VPR --max_criticality 0.99): crit
+            # of exactly 1 zeroes the congestion term and kills
+            # negotiation
+            crit = np.minimum(np.asarray(crit, dtype=np.float32), 0.99)
+        # the tunneled TPU moves ~2 MB/s host<->device, so every
+        # whole-circuit array lives on device for the entire call; the
+        # host loop moves net indices in and scalars out (search.py
+        # "device-resident stepping")
+        occ = self._put_node(jnp.zeros(N, dtype=jnp.int32))
+        acc = self._put_node(jnp.ones(N, dtype=jnp.float32))
+        # bb-adaptive path-slot budget (see route() notes)
+        if R:
+            span0 = int(((term.bb_xmax - term.bb_xmin)
+                         + (term.bb_ymax - term.bb_ymin)).max())
+        else:
+            span0 = 8
+        L = path_budget(span0, self.max_len)
+        if resume is None:
+            paths = jnp.full((R, Smax, L), N, dtype=jnp.int32)
+            sink_delay = jnp.full((R, Smax), jnp.inf,
+                                  dtype=jnp.float32)
+            all_reached = jnp.zeros(R, dtype=bool)
+            bb = jnp.asarray(np.stack(
+                [term.bb_xmin, term.bb_xmax, term.bb_ymin,
+                 term.bb_ymax], axis=1).astype(np.int32))
+        else:
+            # re-upload the checkpointed negotiation under THIS mesh
+            # (elastic shrink/grow: the sharding comes from this
+            # Router's layout, not the checkpoint's origin); no fresh
+            # allocation — the checkpoint IS the path store
+            occ = self._put_node(jnp.asarray(resume.occ))
+            acc = self._put_node(jnp.asarray(resume.acc))
+            paths = jnp.asarray(resume.paths)
+            crit = resume.crit
+            sink_delay = jnp.asarray(resume.sink_delay)
+            all_reached = jnp.asarray(resume.all_reached)
+            bb = jnp.asarray(resume.bb)
+        full_bb = jnp.asarray(np.array(
+            [0, rr.grid.nx + 1, 0, rr.grid.ny + 1], dtype=np.int32))
+        source_d = jnp.asarray(term.source.astype(np.int32))
+        sinks_d = jnp.asarray(term.sinks.astype(np.int32))
+        nsinks_np = term.num_sinks.astype(np.int64)
+        cx_np = ((term.bb_xmin + term.bb_xmax) // 2).astype(np.int64)
+        cy_np = ((term.bb_ymin + term.bb_ymax) // 2).astype(np.int64)
+        planes_tbl = self._planes_terminals(term)
+        result = RouteResult(False, 0, None, None, None, 0)
+        # structured per-(window, category) logging (zlog/MDC
+        # equivalent): no-op unless a stats_dir sink is configured.
+        # Context-managed AROUND the yield loop, so an abandoned
+        # generator (gen.close() on an evicted job) still closes the
+        # per-window file handles via GeneratorExit
+        from ..mdclog import MdcLogger
+        tr = get_tracer()
+        if opts.stats_dir:
+            # a stats_dir run is the diagnostics mode: the device-
+            # truth profiler rides along and dumps devprof.json
+            get_devprof().enabled = True
+        with MdcLogger(opts.stats_dir,
+                       t0=tr.t0 if tr is not None else None) as mlog:
+            result = yield from self._route_planes_windows(
+                term, crit, timing_cb, analyzer, occ, acc, paths,
+                sink_delay, all_reached, bb, full_bb, source_d,
+                sinks_d, planes_tbl, nsinks_np, cx_np, cy_np,
+                result, B, mlog, crop=crop, resume=resume)
+        return result
+
     def route(self, term: NetTerminals,
               crit: Optional[np.ndarray] = None,
               timing_cb: Optional[Callable[["RouteResult"], np.ndarray]]
@@ -2255,6 +2458,14 @@ class Router:
             timing_cb = analyzer.timing_cb
         if resume is not None and self.pg is None:
             raise ValueError("resume is supported by the planes program")
+        if self.pg is not None:
+            # planes path: setup + window loop live in route_gen (a
+            # generator yielding one WindowDispatchRequest per fused
+            # window); route() is its trivial solo executor —
+            # behavior-identical to the pre-generator inline dispatch
+            return self._drive_windows(self.route_gen(
+                term, crit=crit, timing_cb=timing_cb,
+                analyzer=analyzer, resume=resume))
         opts = self.opts
         # multi-route safety (the serve loop calls route() many times
         # on one process): re-assert THIS router's persistent compile
@@ -2351,24 +2562,6 @@ class Router:
         wide = np.zeros(R, dtype=bool)   # nets routed in global space
         bb_full = np.zeros(R, dtype=bool)  # nets already on full-device bb
         win_row = None                   # net id -> compacted table row
-        planes_tbl = None
-        if self.pg is not None:
-            # per-net terminal entry tables (planes.PlanesTerminals);
-            # cached across route() calls on the same terminals — the
-            # tunnel uploads them once and they stay device-resident
-            if getattr(self, "_pt_key", None) != id(term):
-                from .planes import build_planes_terminals
-                pt = build_planes_terminals(
-                    rr, term.source, term.sinks,
-                    np.asarray(self.pg.cell_of_node), self.pg.ncells)
-                self._pt = tuple(jnp.asarray(a) for a in (
-                    pt.opin_node, pt.entry_cell, pt.entry_oidx,
-                    pt.entry_delay, pt.sink_uid, pt.uid_cell,
-                    pt.uid_ipin, pt.uid_delay, pt.direct_oidx,
-                    pt.direct_ipin, pt.direct_delay))
-                self._pt_key = id(term)
-                self._pt_ref = term          # keep id(term) alive
-            planes_tbl = self._pt
         if opts.windowed and self.pg is None:
             # chunk over nets: window_sizes/build_windows hold an
             # [chunk, N] membership intermediate — unchunked that is
@@ -2404,26 +2597,6 @@ class Router:
 
         pres_fac = opts.initial_pres_fac
         result = RouteResult(False, 0, None, None, None, 0)
-        if self.pg is not None:
-            # structured per-(window, category) logging (zlog/MDC
-            # equivalent, parallel_route/log.cxx:40-68): no-op unless a
-            # stats_dir sink is configured.  Context-managed so an
-            # exception mid-negotiation cannot leak open per-window
-            # file handles; sharing the tracer's clock makes mdclog `t`
-            # values directly comparable with span timestamps
-            from ..mdclog import MdcLogger
-            tr = get_tracer()
-            if opts.stats_dir:
-                # a stats_dir run is the diagnostics mode: the device-
-                # truth profiler rides along and dumps devprof.json
-                get_devprof().enabled = True
-            with MdcLogger(opts.stats_dir,
-                           t0=tr.t0 if tr is not None else None) as mlog:
-                return self._route_planes_windows(
-                    term, crit, timing_cb, analyzer, occ, acc, paths,
-                    sink_delay, all_reached, bb, full_bb, source_d,
-                    sinks_d, planes_tbl, nsinks_np, cx_np, cy_np,
-                    result, B, mlog, crop=crop, resume=resume)
         if win is not None:
             result.windowed_nets = int((~wide).sum())
         n_over = -1                      # previous iteration's overuse

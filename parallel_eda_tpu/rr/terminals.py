@@ -79,3 +79,28 @@ def net_terminals(pnl: PackedNetlist, rr: RRGraph, pos: np.ndarray,
         source=source, sinks=sinks, num_sinks=num_sinks,
         bb_xmin=bbx0, bb_xmax=bbx1, bb_ymin=bby0, bb_ymax=bby1,
     )
+
+
+def subset_terminals(term: NetTerminals, frac: float,
+                     seed: int = 1) -> NetTerminals:
+    """Seeded random subset of the routable nets, SAME device grid.
+
+    The multi-tenant serving layer needs "tiny job on a big device"
+    workloads (a daemon serves one graph, so a small job cannot shrink
+    the grid — it routes fewer nets on it).  The subset is drawn from
+    ``seed`` alone, so a submission spec carrying (circuit seed,
+    net_frac, net_seed) is a complete, replayable description of the
+    job — delivery retries can never change what gets routed.  Max
+    fanout padding is left untouched: the sliced job shares the solo
+    circuit's Smax, keeping its dispatch shapes on the same ladder."""
+    R = term.num_nets
+    k = max(1, min(R, int(round(R * float(frac)))))
+    if k >= R:
+        return term
+    idx = np.sort(np.random.RandomState(int(seed)).choice(
+        R, size=k, replace=False))
+    return NetTerminals(
+        net_ids=term.net_ids[idx], source=term.source[idx],
+        sinks=term.sinks[idx], num_sinks=term.num_sinks[idx],
+        bb_xmin=term.bb_xmin[idx], bb_xmax=term.bb_xmax[idx],
+        bb_ymin=term.bb_ymin[idx], bb_ymax=term.bb_ymax[idx])

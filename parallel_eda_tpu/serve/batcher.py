@@ -70,6 +70,24 @@ class CrossJobPlan:
     def total_nets(self) -> int:
         return sum(r.nets for r in self.rungs)
 
+    @property
+    def lane_occupancy(self) -> float:
+        """Net-weighted lane occupancy across the shared rungs — the
+        number ``route.serve.pack.lane_occupancy`` publishes."""
+        if not self.rungs:
+            return 0.0
+        return round(sum(r.lane_occupancy * r.nets for r in self.rungs)
+                     / max(1, self.total_nets), 4)
+
+    def signature(self) -> Tuple:
+        """Canonicalized pack shape: the rung descriptor table + block
+        layout, independent of job identity and arrival order.  Packs
+        that quantize to the same signature dispatch through the same
+        compiled program family, so a join/finish that lands on an
+        already-seen signature recompiles nothing."""
+        return tuple((r.tile, r.shape_x, r.shape_y, r.block_nets,
+                      r.blocks) for r in self.rungs)
+
     def job_slots(self, job_id: str) -> List[Tuple[int, int, int]]:
         """[(rung, packed_slot, job_net_idx)] for one job."""
         out = []
@@ -77,6 +95,32 @@ class CrossJobPlan:
             for s, idx in r.demux().get(job_id, []):
                 out.append((ri, s, idx))
         return out
+
+
+#: machine-readable rebatch causes (flow_doctor validates against this)
+REBATCH_CAUSES = ("join", "finish", "evict", "failover")
+
+
+def diff_packs(prev_ids, cur_ids,
+               is_done=None, is_failover=None) -> List[Dict[str, str]]:
+    """Classify one rebatch boundary: which jobs entered/left the
+    co-admitted set between two slice rounds, each with a
+    machine-readable cause from ``REBATCH_CAUSES``.  ``is_done`` /
+    ``is_failover`` are job_id predicates supplied by the scheduler
+    (queue terminal state; fleet failover admission) — without them
+    entries default to ``join`` and exits to ``evict``."""
+    prev = frozenset(prev_ids or ())
+    cur = frozenset(cur_ids)
+    causes: List[Dict[str, str]] = []
+    for jid in sorted(cur - prev):
+        fo = is_failover is not None and is_failover(jid)
+        causes.append({"job_id": jid, "cause": "failover" if fo
+                       else "join"})
+    for jid in sorted(prev - cur):
+        done = is_done is not None and is_done(jid)
+        causes.append({"job_id": jid, "cause": "finish" if done
+                       else "evict"})
+    return causes
 
 
 def pack_jobs(job_nets: Dict[str, Tuple[np.ndarray, np.ndarray]],
@@ -145,12 +189,10 @@ def pack_jobs(job_nets: Dict[str, Tuple[np.ndarray, np.ndarray]],
 
     plan = CrossJobPlan(rungs=rungs, jobs=jobs)
     if publish_gauges and rungs:
-        occ = (sum(r.lane_occupancy * r.nets for r in rungs)
-               / max(1, plan.total_nets))
         get_metrics().set_gauges({
             "route.serve.pack.jobs": len(jobs),
             "route.serve.pack.shared_rungs": len(rungs),
             "route.serve.pack.nets": plan.total_nets,
-            "route.serve.pack.lane_occupancy": round(occ, 4),
+            "route.serve.pack.lane_occupancy": plan.lane_occupancy,
         })
     return plan

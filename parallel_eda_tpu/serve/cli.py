@@ -66,6 +66,26 @@ def build_parser() -> argparse.ArgumentParser:
                    "job config)")
     p.add_argument("--sync", action="store_true",
                    help="disable the host-device pipeline")
+    p.add_argument("--fused", action="store_true",
+                   help="continuous batching: co-admit every runnable "
+                   "job into one fused lockstep dispatch per slice "
+                   "round, rebatched at each join/finish/evict "
+                   "(serve/fused.py)")
+    p.add_argument("--stagger", type=int, default=0,
+                   help="admit jobs in waves of this many per batch "
+                   "round instead of all upfront (exercises rebatch "
+                   "joins; 0 = admit everything before running)")
+    p.add_argument("--profile", default="uniform",
+                   choices=["uniform", "small-heavy"],
+                   help="job-size mix (mirrors tools/traffic_gen.py): "
+                   "'small-heavy' routes a seeded net SUBSET of each "
+                   "non-heavy job's circuit on the same grid "
+                   "(rr/terminals.subset_terminals) — the lane-waste "
+                   "shape continuous batching recovers")
+    p.add_argument("--small_frac", type=float, default=0.15,
+                   help="net fraction a small-heavy tiny job routes")
+    p.add_argument("--heavy_every", type=int, default=4,
+                   help="in small-heavy, every Nth job is full-size")
     p.add_argument("--checkpoint_dir", default="",
                    help="durable crash-safe job checkpoints (resil/"
                    "checkpoint.py): preempted slices flush here and a "
@@ -134,14 +154,63 @@ def main(argv=None) -> int:
         cfg=dict(luts=args.luts, chan_width=args.chan_width,
                  jobs=args.jobs, batch=args.batch_size,
                  slice=args.slice_iters),
-        resil=resil)
-    for j, f in enumerate(flows):
+        resil=resil, fused=args.fused)
+
+    terms = {}
+    if args.profile == "small-heavy":
+        # seeded tiny-job subsets, fixed before any admission (the
+        # same plan-fixed-before-delivery contract traffic_gen keeps)
+        import random as _random
+
+        from ..rr.terminals import subset_terminals
+        rng = _random.Random(args.seed0)
+        he = max(1, args.heavy_every)
+        for j, f in enumerate(flows):
+            frac = round(args.small_frac * rng.uniform(0.6, 1.4), 4)
+            sub_seed = rng.randrange(1, 10_000)
+            if j % he != he - 1:
+                terms[j] = subset_terminals(f.term, frac, seed=sub_seed)
+
+    def _admit(j, f):
         svc.admit(
-            ServeJobSpec(term=f.term, name=f"l{args.luts}_s{args.seed0 + j}",
+            ServeJobSpec(term=terms.get(j, f.term),
+                         name=f"l{args.luts}_s{args.seed0 + j}"
+                              + ("_tiny" if j in terms else ""),
                          max_iterations=args.max_router_iterations),
             tenant=f"t{j % max(1, args.tenants)}",
             deadline_s=args.deadline_s or None,
             max_retries=args.retries)
+
+    pending = list(enumerate(flows))
+    first = (len(pending) if args.stagger <= 0
+             else min(args.stagger, len(pending)))
+    for j, f in pending[:first]:
+        _admit(j, f)
+    del pending[:first]
+    if pending:
+        # staggered stream: the next wave joins at each slice
+        # boundary, exercising the rebatch path mid-drain
+        def _admit_wave():
+            for j, f in pending[:args.stagger]:
+                _admit(j, f)
+            del pending[:args.stagger]
+
+        if args.fused:
+            inner_b = svc._batch_runner
+
+            def _wrapped_batch(batch):
+                out = inner_b(batch)
+                _admit_wave()
+                return out
+            svc._batch_runner = _wrapped_batch
+        else:
+            inner_r = svc._runner
+
+            def _wrapped_runner(job):
+                out = inner_r(job)
+                _admit_wave()
+                return out
+            svc._runner = _wrapped_runner
 
     jobs = svc.run()
     exported = 0
@@ -167,6 +236,7 @@ def main(argv=None) -> int:
         "dispatch_cache_hits": m.counter(
             "route.dispatch.cache_hits").value,
         "serve": serve_vals,
+        "rebatch": svc.rebatch_summary(),
         "library_exported": exported,
         "wall_s": round(time.perf_counter() - t_start, 3),
     }

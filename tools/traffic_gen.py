@@ -55,6 +55,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=1,
                    help="stream seed: circuits, tenants, priorities "
                    "and gaps all replay from it")
+    p.add_argument("--profile", default="uniform",
+                   choices=["uniform", "small-heavy"],
+                   help="job-size mix: 'uniform' routes each job's "
+                   "full circuit; 'small-heavy' staggers many tiny "
+                   "jobs (a seeded net subset on the SAME grid, spec "
+                   "net_frac) among a few full-size ones — the "
+                   "lane-waste shape continuous batching recovers")
+    p.add_argument("--small_frac", type=float, default=0.15,
+                   help="net fraction a small-heavy tiny job routes")
+    p.add_argument("--heavy_every", type=int, default=4,
+                   help="in small-heavy, every Nth job is full-size")
     p.add_argument("--max_iterations", type=int, default=0)
     p.add_argument("--deadline_s", type=float, default=0.0,
                    help="per-job deadline drawn up to this bound "
@@ -88,6 +99,19 @@ def make_stream(args) -> list:
                      "seed": circuit_seed,
                      "name": f"l{args.luts}_s{circuit_seed}"},
         }
+        if getattr(args, "profile", "uniform") == "small-heavy":
+            # many tiny jobs among a few full-size ones.  The subset
+            # (net_frac + net_seed) is part of the spec, fixed HERE in
+            # the plan — delivery retries replay the identical spec,
+            # so the plan-fixed-before-delivery contract holds for
+            # job size exactly as it does for the circuit seed.
+            heavy = (i % max(1, args.heavy_every)
+                     == max(1, args.heavy_every) - 1)
+            if not heavy:
+                job["spec"]["net_frac"] = round(
+                    args.small_frac * rng.uniform(0.6, 1.4), 4)
+                job["spec"]["net_seed"] = rng.randrange(1, 10_000)
+                job["spec"]["name"] += "_tiny"
         if args.max_iterations:
             job["spec"]["max_iterations"] = args.max_iterations
         if args.deadline_s > 0:
