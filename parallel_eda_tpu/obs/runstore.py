@@ -63,6 +63,12 @@ REQUIRED_FIELDS = (
 # written before the dtype era stay valid and comparable)
 OPTIONAL_STR_FIELDS = ("tenant", "job_id", "plane_dtype")
 
+# optional int fields, same contract: the device-mesh shard count a
+# multi-chip run relaxed with (scale_bench --mesh).  Absent means 1 —
+# a single-device row written before (or without) the mesh era is the
+# same shape as always, so MULTICHIP_* rows mix with BENCH_* readers.
+OPTIONAL_INT_FIELDS = ("n_shards",)
+
 _SCENARIO_OK = re.compile(r"[^A-Za-z0-9._-]+")
 
 
@@ -115,7 +121,8 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
                 repo_dir: Optional[str] = None,
                 tenant: Optional[str] = None,
                 job_id: Optional[str] = None,
-                plane_dtype: Optional[str] = None) -> dict:
+                plane_dtype: Optional[str] = None,
+                n_shards: Optional[int] = None) -> dict:
     rec = {
         "schema_version": SCHEMA_VERSION,
         "ts": ts or now_iso(),
@@ -134,6 +141,8 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
         rec["job_id"] = str(job_id)
     if plane_dtype is not None:
         rec["plane_dtype"] = str(plane_dtype)
+    if n_shards is not None:
+        rec["n_shards"] = int(n_shards)
     for key, val in (("qor", qor), ("gauges", gauges),
                      ("series", series), ("congestion", congestion),
                      ("detail", detail), ("tags", tags)):
@@ -165,6 +174,11 @@ def validate_record(rec) -> list:
         if name in rec and not isinstance(rec[name], str):
             errs.append(f"field {name!r} has type "
                         f"{type(rec[name]).__name__}, wanted str")
+    for name in OPTIONAL_INT_FIELDS:
+        if name in rec and (not isinstance(rec[name], int)
+                            or isinstance(rec[name], bool)):
+            errs.append(f"field {name!r} has type "
+                        f"{type(rec[name]).__name__}, wanted int")
     sv = rec.get("schema_version")
     if isinstance(sv, int) and sv > SCHEMA_VERSION:
         errs.append(f"schema_version {sv} is newer than this reader's "

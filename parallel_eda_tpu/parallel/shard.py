@@ -65,12 +65,32 @@ def make_mesh(n_devices: Optional[int] = None,
     virtual CPU mesh; see tests/test_parallel.py.)"""
     devs = jax.devices()
     if n_devices is not None:
+        if n_devices < 1:
+            raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+        if n_devices > len(devs):
+            raise ValueError(f"asked for {n_devices} devices but only "
+                             f"{len(devs)} are visible (on CPU hosts "
+                             f"set XLA_FLAGS=--xla_force_host_platform"
+                             f"_device_count={n_devices} before jax "
+                             f"initialises)")
         devs = devs[:n_devices]
     n = len(devs)
     if shape is None:
         shape = (n, 1)
+    shape = tuple(shape)
+    # validate BEFORE any shape[i] access: a 1-tuple like (4,) used to
+    # escape as an IndexError on shape[1] instead of a usable message
+    if len(shape) != 2:
+        raise ValueError(f"mesh shape must be 2-D (net, node), got "
+                         f"{shape!r} with {len(shape)} axis(es)")
+    if not all(isinstance(s, (int, np.integer)) and s >= 1
+               for s in shape):
+        raise ValueError(f"mesh shape axes must be positive ints, got "
+                         f"{shape!r}")
     if shape[0] * shape[1] != n:
-        raise ValueError(f"mesh shape {shape} != {n} devices")
+        raise ValueError(f"mesh shape {shape} needs "
+                         f"{shape[0] * shape[1]} devices, have {n} "
+                         f"(net axis {shape[0]} x node axis {shape[1]})")
     return Mesh(np.array(devs).reshape(shape), (NET, NODE))
 
 

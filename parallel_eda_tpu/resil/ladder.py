@@ -1,6 +1,6 @@
 """The graceful-degradation ladder.
 
-Five dimensions, each an ordered list of execution levels, fastest
+Six dimensions, each an ordered list of execution levels, fastest
 first (all bit-identical except "dtype", whose levels are
 QoR-identical under the router's shadow-oracle guard):
 
@@ -12,6 +12,13 @@ QoR-identical under the router's shadow-oracle guard):
             oracle — router._dtype_band_ok)
   dispatch: fused -> per_rung   (one ragged packed dispatch per
             window vs one dispatch per populated crop rung)
+  mesh:     pallas_halo -> ppermute -> single_chip   (multi-chip
+            halo-exchange relaxation, route/planes_shard.py: the
+            overlapped remote-DMA transport, the on-critical-path
+            ppermute transport, and the one-device floor a lost mesh
+            member lands on — router._mesh_demote).  pallas_halo only
+            engages on TPU backends; elsewhere ppermute is the top
+            working rung.  Inert unless RouterOpts.mesh_shards > 1.
 
 "kernel" and "program" descend *per dispatch-variant* inside
 ``DispatchGuard`` (quarantine picks the rung); the ladder records
@@ -38,6 +45,7 @@ DIMS: Dict[str, tuple] = {
     "program": ("aot", "jit"),
     "dtype": ("bf16", "f32"),
     "dispatch": ("fused", "per_rung"),
+    "mesh": ("pallas_halo", "ppermute", "single_chip"),
 }
 
 # Rung labels (watchdog chain) -> ladder dimension, for step records.
@@ -51,6 +59,9 @@ _LABEL_DIM = {
     "f32": "dtype",
     "fused": "dispatch",
     "per_rung": "dispatch",
+    "pallas_halo": "mesh",
+    "ppermute": "mesh",
+    "single_chip": "mesh",
 }
 
 

@@ -1128,6 +1128,17 @@ def planes_relax_cropped(pg: PlanesGraph, d0_flat, cc_flat, crit_c,
 # ---------------------------------------------------------------------------
 
 
+def _as_row_mesh(mesh):
+    """The window programs' ``mesh`` static carries either a legacy
+    (net, node) GSPMD Mesh or a planes_shard.RowMesh (explicit halo
+    exchange).  Returns the RowMesh, or None for the GSPMD/absent
+    cases — callers branch the relax dispatch on it."""
+    if mesh is None:
+        return None
+    from .planes_shard import RowMesh
+    return mesh if isinstance(mesh, RowMesh) else None
+
+
 def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                paths, sink_delay, all_reached, bb,
                source_all, sinks_all, crit_all,
@@ -1171,7 +1182,7 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
     b_doidx = direct_oidx_all[sel]               # [B, S] (-1 = none)
     b_dipin = direct_ipin_all[sel]
     b_ddel = direct_delay_all[sel]
-    if mesh is not None:
+    if mesh is not None and _as_row_mesh(mesh) is None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def c(x, *spec):
@@ -1311,6 +1322,11 @@ def _step_core(pg: PlanesGraph, dev: DeviceRRGraph, occ, acc, pres_fac,
                 pg, d0, cc_flat, crit_c, wenter0, nsweeps,
                 crop_ox, crop_oy, cnx_t, cny_t,
                 plane_dtype=plane_dtype)
+        elif _as_row_mesh(mesh) is not None:
+            from .planes_shard import planes_relax_sharded
+            dist, pred, wenter, rst = planes_relax_sharded(
+                pg, d0, cc_flat, crit_c, wenter0, nsweeps,
+                _as_row_mesh(mesh), plane_dtype=plane_dtype)
         else:
             dist, pred, wenter, rst = planes_relax(pg, d0, cc_flat,
                                                    crit_c, wenter0,

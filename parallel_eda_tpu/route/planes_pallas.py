@@ -592,3 +592,71 @@ def planes_relax_cropped_pallas(pg: PlanesGraph, d0_flat, cc_flat,
              unfold6(wx, shx, pyx), unfold6(wy, shy, pyy))
     bstats = jnp.stack([stats[:, 0].max(), stats[:, 1].max()])
     return scatter_state(gm_full, fulls, tiles, ox, oy) + (bstats,)
+
+
+def remote_slab_permute(slab, axis_name, n_shards, fwd=True):
+    """Halo-slab neighbor exchange over the TPU interconnect (RDMA).
+
+    Transport for the mesh ladder's top rung ("pallas_halo",
+    route/planes_shard.py): inside the shard_map body each device
+    pushes its boundary dist slab ([B, W, 1-or-2, Y]) directly into the
+    neighbor's output buffer with ``pltpu.make_async_remote_copy`` —
+    a one-hop ICI DMA instead of the collective-scheduled
+    ``lax.ppermute`` the middle rung uses.  The overlap itself lives in
+    planes_shard's lag-2 schedule: the halo installed before sweep k
+    was extracted before sweep k-1 ran, so two exchange generations are
+    in flight at once and this DMA hides behind the interior sub-sweep
+    (route.mesh.overlap_frac models the hide).
+
+    Semantics match the non-wrapping ``lax.ppermute`` shift exactly:
+    ``fwd=True`` sends shard i -> i+1 (the last shard sends nothing),
+    ``fwd=False`` sends i -> i-1 (the first sends nothing), and an edge
+    shard with no inbound neighbor returns zeros — planes_shard masks
+    those halos to +inf by row index, so the two transports stay
+    bit-identical and rung demotion cannot move QoR.
+
+    TPU-only (callers gate on ``jax.default_backend() == "tpu"``): the
+    remote-DMA primitives have no interpret-mode lowering, so on CPU
+    hosts the ppermute rung is the top of the mesh ladder.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        me = jax.lax.axis_index(axis_name)
+        if fwd:
+            neighbor, sends, recvs = me + 1, me < n_shards - 1, me > 0
+        else:
+            neighbor, sends, recvs = me - 1, me > 0, me < n_shards - 1
+        copy = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=o_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=neighbor,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        @pl.when(jnp.logical_not(recvs))
+        def _zero_edge():
+            o_ref[...] = jnp.zeros_like(o_ref[...])
+
+        @pl.when(sends)
+        def _start():
+            copy.start()
+
+        @pl.when(sends)
+        def _wait_send():
+            copy.wait_send()
+
+        @pl.when(recvs)
+        def _wait_recv():
+            copy.wait_recv()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(slab.shape, slab.dtype),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+        compiler_params=pltpu.TPUCompilerParams(
+            has_side_effects=True,
+            # fwd/bwd exchanges of one sweep overlap; distinct barrier
+            # semaphores keep their matched-send/recv pairs separate.
+            collective_id=0 if fwd else 1,
+        ),
+    )(slab)

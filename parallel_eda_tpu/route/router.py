@@ -233,6 +233,20 @@ class RouterOpts:
     # watchdog chain / devprof all apply); under resil it degrades
     # fused -> per_rung via the ladder "dispatch" dimension.
     fused_dispatch: bool = False
+    # Multi-chip halo-exchange routing (route/planes_shard.py): shard
+    # the relaxation canvases over a 1-D device mesh on the canvas row
+    # axis, each chip relaxing its own column block and exchanging
+    # only the boundary halo columns between sweeps.  1 = single-chip
+    # (default).  N > 1 needs N visible devices (on CPU hosts set
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N before jax
+    # initializes) and program="planes" — the packed Pallas program
+    # and the legacy (net, node) GSPMD mesh are mutually exclusive
+    # with it.  Rides the resil ladder's "mesh" dimension
+    # (pallas_halo -> ppermute -> single_chip): the overlapped
+    # remote-DMA transport engages on TPU backends, ppermute is the
+    # portable rung, and a lost mesh member (backend.loss) demotes to
+    # the single-chip floor so the route still completes.
+    mesh_shards: int = 1
 
 
 @dataclass
@@ -720,6 +734,32 @@ class Router:
                     f"program='ell' for foreign graphs")
             self.pg = build_planes(rr)
         self.mesh = mesh
+        # multi-chip halo-exchange sharding (opts.mesh_shards > 1):
+        # one RowMesh per transport impl — the ladder's "mesh"
+        # dimension picks which one a window dispatches under
+        self._row_meshes = None
+        self._mesh_lost = False
+        if self.opts.mesh_shards > 1:
+            if mesh is not None:
+                raise ValueError(
+                    "mesh_shards > 1 and a legacy (net, node) mesh are "
+                    "mutually exclusive — the halo-exchange sharding "
+                    "owns the device mesh")
+            if self.use_pallas:
+                raise ValueError(
+                    "program='planes_pallas' does not support "
+                    "mesh_shards > 1 (the packed kernel is "
+                    "single-device VMEM-resident); use "
+                    "program='planes' — the sharded pallas_halo rung "
+                    "engages on TPU backends")
+            if self.pg is None:
+                raise ValueError(
+                    "mesh_shards > 1 needs a planes program "
+                    "(program='planes')")
+            from .planes_shard import make_row_mesh
+            self._row_meshes = {
+                impl: make_row_mesh(self.opts.mesh_shards, impl)
+                for impl in ("ppermute", "pallas_halo")}
         # reusable plan staging slots (hash-skipped non-blocking
         # uploads) + persistent compile cache, both for the pipelined
         # window driver
@@ -740,7 +780,7 @@ class Router:
         # at the dispatch site
         self._library = None
         if self.opts.program_library_dir and mesh is None \
-                and self.pg is not None:
+                and self.opts.mesh_shards <= 1 and self.pg is not None:
             from ..serve.library import ProgramLibrary
             self._library = lib = ProgramLibrary(
                 self.opts.program_library_dir)
@@ -774,6 +814,102 @@ class Router:
             len(self._library.keys()))
         return n
 
+    def _active_row_mesh(self, lad):
+        """The RowMesh the next window should relax under, per the
+        resil ladder's "mesh" dimension (None = the single-chip
+        floor).  Level 0 (pallas_halo, the overlapped remote-DMA
+        transport) only engages where that transport exists — TPU
+        backends; elsewhere ppermute is the top working rung, the
+        same off-accelerator skip the kernel dimension applies to
+        pallas rungs."""
+        if self._row_meshes is None or self._mesh_lost:
+            return None
+        lvl = 0 if lad is None else lad.level("mesh")
+        if lvl >= 2:
+            return None
+        if lvl == 0 and jax.default_backend() == "tpu":
+            return self._row_meshes["pallas_halo"]
+        return self._row_meshes["ppermute"]
+
+    def _check_mesh_member(self, resil_rt, rm):
+        """backend.loss injection point for the sharded rungs: fires
+        BEFORE the jitted call (donated buffers survive, the retry is
+        safe) and is STICKY — a lost device stays lost, so the
+        watchdog's same-rung retry fails too and the chain descends
+        to the single-chip rung instead of flapping."""
+        from ..resil.faults import BackendLostError, Fault
+        if self._mesh_lost:
+            raise BackendLostError(Fault(
+                "backend.loss", -1,
+                f"mesh member lost earlier (n_shards={rm.n_shards})"))
+        plan = getattr(resil_rt, "plan", None)
+        if plan is not None:
+            try:
+                plan.raise_if(
+                    "backend.loss",
+                    detail=f"shard of row mesh n={rm.n_shards}")
+            except BackendLostError:
+                self._mesh_lost = True
+                raise
+
+    def _mesh_demote(self, resil_rt, reason: str) -> None:
+        """Quarantine hook for a sharded rung.  A lost mesh member
+        makes EVERY sharded impl unrunnable, so the ladder lands
+        straight on the single-chip floor; any other quarantine cause
+        (watchdog budget, injected dispatch fault) steps one level
+        like the kernel dimension does."""
+        from ..resil.ladder import DIMS
+        lad = getattr(resil_rt, "ladder", None)
+        moved = False
+        if lad is not None:
+            floor = len(DIMS["mesh"]) - 1
+            if self._mesh_lost:
+                while lad.level("mesh") < floor:
+                    lad.step("mesh", reason)
+                    moved = True
+            else:
+                moved = lad.step("mesh", reason)
+        if moved or lad is None:
+            get_metrics().counter("route.mesh.mesh_demotions").inc()
+
+    def _guarded_dispatch_mesh(self, resil_rt, vkey, wp_args,
+                               wp_kwargs, rm):
+        """Window dispatch chain when a RowMesh is active: the planned
+        transport rung first, then (for pallas_halo) the portable
+        ppermute transport, then the single-chip floor.  All rungs are
+        route-level QoR-identical (the sharded fixpoint equals the
+        single-device one; see planes_shard).  The AOT library and
+        Pallas kernel rungs never appear here — both are rejected with
+        mesh_shards > 1 at construction."""
+        from ..resil.watchdog import Rung
+        from .planes import route_window_planes
+
+        def mesh_run(label, rm_):
+            def run():
+                _note_dispatch_variant(
+                    vkey if label == rm.impl else vkey + (label,))
+                self._check_mesh_member(resil_rt, rm_)
+                return route_window_planes(
+                    *(wp_args[:-1] + (rm_,)), **wp_kwargs)
+            return run
+
+        def quar(reason):
+            self._mesh_demote(resil_rt, reason)
+
+        rungs = [Rung(rm.impl, mesh_run(rm.impl, rm), quar)]
+        if rm.impl == "pallas_halo":
+            rungs.append(Rung(
+                "ppermute",
+                mesh_run("ppermute", rm.with_impl("ppermute")), quar))
+
+        def run_single():
+            _note_dispatch_variant(vkey + ("single_chip",))
+            return route_window_planes(
+                *(wp_args[:-1] + (None,)), **wp_kwargs)
+
+        rungs.append(Rung("single_chip", run_single))
+        return resil_rt.guard.run(vkey, rungs)
+
     def _guarded_dispatch(self, resil_rt, vkey, wp_args, wp_kwargs):
         """Window dispatch under the resilience guard: an ordered
         chain of BIT-IDENTICAL execution rungs, fastest first, handed
@@ -783,7 +919,11 @@ class Router:
         its own variant key so route.dispatch.{compiles,cache_hits}
         stays honest about which program actually ran."""
         from ..resil.watchdog import Rung
-        from .planes import route_window_planes
+        from .planes import _as_row_mesh, route_window_planes
+        rm = _as_row_mesh(wp_args[-1])
+        if rm is not None:
+            return self._guarded_dispatch_mesh(resil_rt, vkey, wp_args,
+                                               wp_kwargs, rm)
         ladder = resil_rt.ladder
         rungs = []
         if (self._library is not None
@@ -837,9 +977,43 @@ class Router:
         exhausts this chain retries per-rung, where _guarded_dispatch's
         usual rungs apply."""
         from ..resil.watchdog import Rung
-        from .planes import route_window_planes_fused
+        from .planes import _as_row_mesh, route_window_planes_fused
         ladder = resil_rt.ladder
         rungs = []
+        rm = _as_row_mesh(f_kwargs.get("mesh"))
+        if rm is not None:
+            # sharded fused ladder: transport rungs first (each fires
+            # the sticky backend.loss check before the jitted call),
+            # then the single-chip fused program, then the sequential
+            # per-rung fallback — same shape as the unsharded chain
+            # below with the mesh dimension stacked on top
+            def mesh_run(label, rm_):
+                def run():
+                    _note_dispatch_variant(
+                        vkey if label == rm.impl else vkey + (label,))
+                    self._check_mesh_member(resil_rt, rm_)
+                    return route_window_planes_fused(
+                        *f_args, **{**f_kwargs, "mesh": rm_})
+                return run
+
+            def quar(reason):
+                self._mesh_demote(resil_rt, reason)
+
+            rungs.append(Rung(rm.impl, mesh_run(rm.impl, rm), quar))
+            if rm.impl == "pallas_halo":
+                rungs.append(Rung(
+                    "ppermute",
+                    mesh_run("ppermute", rm.with_impl("ppermute")),
+                    quar))
+
+            def run_single():
+                _note_dispatch_variant(vkey + ("single_chip",))
+                return route_window_planes_fused(
+                    *f_args, **{**f_kwargs, "mesh": None})
+
+            rungs.append(Rung("single_chip", run_single))
+            rungs.append(Rung("per_rung", per_rung_fb))
+            return resil_rt.guard.run(vkey, rungs)
         if (self._library is not None
                 and ladder.level("program") == 0):
             def run_aot():
@@ -1004,7 +1178,9 @@ class Router:
 
         w_steps = w_useful = w_steps_crop = 0
         nroutes = nexec = 0
-        for scal_d, cropped in bk["rung_scals"]:
+        mesh_info = bk.get("mesh")
+        halo_b = halo_ex = 0
+        for ri, (scal_d, cropped) in enumerate(bk["rung_scals"]):
             v = np.asarray(scal_d)
             nroutes += int(v[SCAL_NROUTES])
             nexec += int(v[SCAL_NEXEC])
@@ -1012,6 +1188,15 @@ class Router:
             w_useful += int(v[SCAL_S_USEFUL])
             if cropped:
                 w_steps_crop += int(v[SCAL_S_EXEC])
+            if mesh_info is not None and mesh_info[0] > 1 \
+                    and ri < len(bk["kplans"]):
+                # halo ledger: every executed sweep exchanged one halo
+                # round per internal boundary, at the rung's modeled
+                # per-sweep byte volume (dtype-aware, planes_shard)
+                kp = bk["kplans"][ri]
+                halo_b += (kp.get("halo_bytes_per_sweep", 0)
+                           * int(v[SCAL_S_EXEC]))
+                halo_ex += (mesh_info[0] - 1) * int(v[SCAL_S_EXEC])
         result.total_net_routes += nroutes
         result.total_relax_steps += w_steps
         result.total_relax_steps_useful += w_useful
@@ -1030,6 +1215,22 @@ class Router:
                          bucket_occ=bk["bucket_occ"],
                          compaction=bk["compaction"],
                          kernel_plans=bk["kplans"], tw1=bk["tw1"])
+        if mesh_info is not None:
+            reg = get_metrics()
+            reg.counter("route.mesh.halo_bytes").inc(halo_b)
+            reg.counter("route.mesh.halo_exchanges").inc(halo_ex)
+            # overlap_frac per window: the dominant rung's modeled
+            # hide of the halo exchange behind sweep compute (0.0 on
+            # the critical-path ppermute transport and on single_chip)
+            ov = 0.0
+            if mesh_info[0] > 1 and bk["kplans"]:
+                dom = max(bk["kplans"],
+                          key=lambda kp: kp.get("nets", 0))
+                ov = dom.get("mesh_overlap_frac", 0.0)
+            reg.set_gauges({
+                "route.mesh.n_shards": mesh_info[0],
+                "route.mesh.overlap_frac": ov,
+            })
         # congestion record (corpus + mdclog): in pipelined mode the
         # occ_ref is a non-donated snapshot whose copy_to_host_async
         # was started at the control point — by now (the NEXT window is
@@ -1520,6 +1721,21 @@ class Router:
             fused_now = (bool(opts.fused_dispatch) and self.mesh is None
                          and (lad is None
                               or lad.level("dispatch") == 0))
+            # active mesh for this window: the legacy (net, node) GSPMD
+            # mesh if constructed with one, else the halo-exchange
+            # RowMesh at the resil ladder's current "mesh" level
+            # (re-resolved every window so a mid-route demotion takes
+            # effect at the next window boundary)
+            rm_now = self._active_row_mesh(lad)
+            mesh_now = self.mesh if self.mesh is not None else rm_now
+            mesh_vk = (False if mesh_now is None
+                       else True if rm_now is None
+                       else (rm_now.n_shards, rm_now.impl))
+            if rm_now is not None:
+                # sharded relaxation always runs the full canvas: the
+                # crop ladder is single-device VMEM machinery — the
+                # row mesh splits the canvas across chips instead
+                dispatch = [(dirty, None)]
             sh_stash = []
             sh_state = None
             if shadow_now:
@@ -1605,6 +1821,22 @@ class Router:
                              math.ceil(maxfan / grp_w) + 1)))
                 kplan = self._plan_block_nets(tile, len(sub), nsw,
                                               plane_dtype=pd_main)
+                if rm_now is not None:
+                    # per-chip cost truth for devprof + the halo
+                    # ledger: bytes one sweep's exchange moves at this
+                    # rung's plan width, in the plane storage dtype
+                    # (bf16 halves wire traffic like it halves HBM)
+                    from .planes_shard import (halo_bytes_per_sweep,
+                                               modeled_overlap_frac)
+                    bw = sel_p.shape[1] if len(sub) else 1
+                    kplan = dict(
+                        kplan, mesh_shards=rm_now.n_shards,
+                        mesh_impl=rm_now.impl,
+                        halo_bytes_per_sweep=halo_bytes_per_sweep(
+                            self.pg, bw, rm_now.n_shards, pd_main),
+                        mesh_overlap_frac=modeled_overlap_frac(
+                            self.pg, bw, rm_now.n_shards, rm_now.impl,
+                            pd_main))
                 # staged, hash-skipped plan uploads: identical plans
                 # (endgame windows redispatch the same few dirty nets)
                 # reuse the staged device buffer outright, and fresh
@@ -1643,7 +1875,11 @@ class Router:
                     jnp.int32(it_done + 1 if force_all_next
                               else opts.incremental_after),
                     K, p["nsw"], L, p["waves"], p["grp_w"],
-                    p["doubling"], min(4096, N), 5, self.mesh)
+                    p["doubling"], min(4096, N), 5,
+                    # re-read at call time: the per-rung fallback of a
+                    # window whose mesh member died mid-chain must not
+                    # redispatch onto the dead mesh
+                    None if self._mesh_lost else mesh_now)
 
             def rung_kwargs(p):
                 return dict(use_pallas=self.use_pallas,
@@ -1661,7 +1897,7 @@ class Router:
                 vkey = (p["tile"], K, p["nsw"], L, p["waves"],
                         p["grp_w"], p["doubling"], p["sel_shape"][0],
                         p["sel_shape"][1], p["wok"] is None,
-                        self.use_pallas, self.mesh is not None,
+                        self.use_pallas, mesh_vk,
                         bool(sta_kw), R, Smax, N, pd_main)
                 if resil_rt is None or resil_rt.guard is None:
                     # resil dispatch notes per executed rung instead
@@ -1767,14 +2003,14 @@ class Router:
                     K, L)
                 f_kwargs = dict(
                     rung_desc=rung_desc, topk=min(4096, N),
-                    n_colors=5, mesh=self.mesh,
+                    n_colors=5, mesh=mesh_now,
                     use_pallas=self.use_pallas, bb0_all=bb0_d,
                     widen_oks=widen_oks, plane_dtype=pd_main,
                     **sta_kw)
                 vkey = ("fused", rung_desc, K, L,
                         tuple(p["sel_shape"] for p in plans),
                         widen_oks is None, self.use_pallas,
-                        self.mesh is not None, bool(sta_kw),
+                        mesh_vk, bool(sta_kw),
                         R, Smax, N, pd_main)
                 dom = max(kplans, key=lambda kp: kp.get("nets", 0))
                 get_devprof().note_variant(
@@ -2086,7 +2322,16 @@ class Router:
                 # array), a non-donated async-readback copy when
                 # pipelined — congestion telemetry no longer requires
                 # the synchronous driver
-                occ_ref=self._occ_snapshot(occ, pipelined, mlog))
+                occ_ref=self._occ_snapshot(occ, pipelined, mlog),
+                # mesh ledger state, resolved AFTER the dispatch so a
+                # mid-window demotion books as single-chip: (active
+                # shards, impl) — (1, "single_chip") when the window
+                # ran on one device but sharding was requested, None
+                # when mesh_shards was never on
+                mesh=(None if self._row_meshes is None
+                      else (1, "single_chip")
+                      if (rm_now is None or self._mesh_lost)
+                      else (rm_now.n_shards, rm_now.impl)))
             if analyzer is not None and cpd == cpd:
                 analyzer.crit_path_delay = cpd
             if not pipelined:

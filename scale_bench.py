@@ -47,9 +47,32 @@ def main():
                          "end-to-end route appends one record")
     ap.add_argument("--no_corpus", action="store_true",
                     help="skip the corpus append")
+    ap.add_argument("--mesh", type=int, default=1,
+                    help="shard the planes relaxation over N devices "
+                         "(route/planes_shard.py); on CPU this forces "
+                         "N virtual host devices via XLA_FLAGS, so it "
+                         "must run in a fresh process.  Routes a "
+                         "single-device reference of the same placed "
+                         "circuit and checks bit-identical QoR")
+    ap.add_argument("--multichip_out", default=None,
+                    help="with --mesh > 1: also write a "
+                         "MULTICHIP_r06.json-style probe doc here "
+                         "(default MULTICHIP_r06.json next to this "
+                         "script; 'none' disables)")
     args = ap.parse_args()
     if args.curve_only and args.memory_only:
         ap.error("--curve_only and --memory_only are mutually exclusive")
+    if args.mesh > 1 and (args.curve_only or args.memory_only):
+        ap.error("--mesh needs the end-to-end route section")
+
+    # the host-platform device trick: N virtual CPU devices, decided
+    # BEFORE jax initialises its backends (XLA reads the flag once)
+    if args.mesh > 1 and not args.tpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.mesh}").strip()
 
     import jax
 
@@ -125,10 +148,31 @@ def main():
         f = run_place(f, PlacerOpts(moves_per_step=256), timing_driven=False)
         t_place = time.time() - t0
         log(f"placed in {t_place:.0f}s")
+        # --mesh: route a single-device reference of the SAME placed
+        # circuit first, under a throwaway metrics registry so the
+        # measured (mesh) route's gauge snapshot stays pure.  The mesh
+        # relaxation is bit-identical by construction (planes_shard) —
+        # this check makes the MULTICHIP row load-bearing.
+        ref = None
+        if args.mesh > 1:
+            from parallel_eda_tpu.obs import (MetricsRegistry,
+                                              set_metrics)
+            log(f"mesh({args.mesh}): routing single-device reference")
+            t0 = time.time()
+            old_reg = set_metrics(MetricsRegistry())
+            try:
+                ref = run_route(f, RouterOpts(batch_size=args.batch),
+                                timing_driven=False).route
+            finally:
+                set_metrics(old_reg)
+            log(f"reference routed in {time.time()-t0:.0f}s "
+                f"(wl {ref.wirelength})")
+
+        mesh_kw = ({"mesh_shards": args.mesh} if args.mesh > 1 else {})
         get_devprof().enabled = True
         c0 = compile_seconds()
         t0 = time.time()
-        f = run_route(f, RouterOpts(batch_size=args.batch),
+        f = run_route(f, RouterOpts(batch_size=args.batch, **mesh_kw),
                       timing_driven=False)
         t_route = time.time() - t0
         c_route = compile_seconds() - c0
@@ -167,6 +211,40 @@ def main():
                   f"dispatch compiles / "
                   f"{dvv.get('route.dispatch.cache_hits', 0)} variant "
                   f"cache hits")
+        mesh_info = None
+        if args.mesh > 1:
+            mv = get_metrics().values("route.mesh.")
+            bitid = (res.success and ref.success
+                     and int(res.wirelength) == int(ref.wirelength)
+                     and np.array_equal(np.asarray(res.paths),
+                                        np.asarray(ref.paths))
+                     and np.array_equal(np.asarray(res.occ),
+                                        np.asarray(ref.occ)))
+            mesh_info = {
+                "n_shards": int(args.mesh),
+                "impl": ("pallas_halo" if args.tpu else "ppermute"),
+                "bit_identical": bool(bitid),
+                "wirelength_ref": int(ref.wirelength),
+                "halo_bytes": int(mv.get("route.mesh.halo_bytes")
+                                  or 0),
+                "halo_exchanges":
+                    int(mv.get("route.mesh.halo_exchanges") or 0),
+                "overlap_frac":
+                    float(mv.get("route.mesh.overlap_frac") or 0.0),
+                "mesh_demotions":
+                    int(mv.get("route.mesh.mesh_demotions") or 0),
+            }
+            print(f"- mesh: {args.mesh} shards ({mesh_info['impl']}), "
+                  f"QoR vs single-device reference "
+                  f"{'BIT-IDENTICAL' if bitid else 'DIVERGED'} "
+                  f"(wl {res.wirelength} vs {ref.wirelength}), "
+                  f"{mesh_info['halo_exchanges']} halo exchanges / "
+                  f"{mesh_info['halo_bytes']} halo bytes, overlap "
+                  f"{mesh_info['overlap_frac']}, "
+                  f"{mesh_info['mesh_demotions']} demotions")
+            if not bitid:
+                log("mesh: QoR DIVERGED from the single-device "
+                    "reference — this is a bug (planes_shard parity)")
         get_devprof().capture_all()
         dc = get_devprof().summary()
         if "unavailable" in dc:
@@ -188,10 +266,12 @@ def main():
                 backend = "tpu" if args.tpu else "cpu"
                 dev0 = jax.devices()[0]
                 scen = f"scale_bench_l{args.big}_b{args.batch}"
+                if args.mesh > 1:
+                    scen += f"_m{args.mesh}"
                 rec = _rs.make_record(
                     scen,
                     {"big": args.big, "batch": args.batch,
-                     "tpu": bool(args.tpu)},
+                     "tpu": bool(args.tpu), "mesh": args.mesh},
                     "nets_routed_per_sec",
                     round(res.total_net_routes / max(t_route, 1e-9), 2),
                     "nets/s", backend,
@@ -227,13 +307,43 @@ def main():
                             "stall_ms": pv.get(
                                 "route.pipeline.stall_ms_total")},
                         "obs": {"compile_s_measured": round(c_route, 3)},
+                        **({"mesh": mesh_info} if mesh_info else {}),
                     },
+                    n_shards=(args.mesh if args.mesh > 1 else None),
                     repo_dir=os.path.dirname(os.path.abspath(__file__)))
                 p = _rs.append_run(args.runs_dir, rec)
                 log(f"corpus: appended {scen} row to {p}")
             except Exception as e:
                 log(f"corpus append failed (non-fatal): "
                     f"{type(e).__name__}: {e}")
+        # --mesh: also write the MULTICHIP probe doc (same shape the
+        # driver's dryrun probes wrote in rounds 1-5, so observatory's
+        # legacy importer still parses it; the mesh_* keys are the new
+        # load-bearing measurement)
+        if mesh_info is not None and (args.multichip_out or "") != "none":
+            mc_path = args.multichip_out or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "MULTICHIP_r06.json")
+            import json as _json
+            tail = (f"scale_bench --mesh {args.mesh}: "
+                    f"{'ok' if mesh_info['bit_identical'] else 'DIVERGED'}"
+                    f" — mesh ({args.mesh},), {res.iterations} iters, "
+                    f"wirelength {res.wirelength} "
+                    f"(reference {mesh_info['wirelength_ref']})\n")
+            doc = {"n_devices": int(args.mesh),
+                   "rc": 0 if mesh_info["bit_identical"] else 1,
+                   "ok": bool(mesh_info["bit_identical"]),
+                   "skipped": False,
+                   "tail": tail,
+                   "mesh": mesh_info,
+                   "backend": "tpu" if args.tpu else "cpu",
+                   "luts": int(args.big),
+                   "rr_nodes": int(f.rr.num_nodes),
+                   "route_time_s": round(t_route, 3)}
+            with open(mc_path, "w") as mcf:
+                _json.dump(doc, mcf, indent=2)
+                mcf.write("\n")
+            log(f"mesh: wrote probe doc {mc_path}")
         print(f"- legality: verified by the independent checker (run_route)")
         print(f"- obs: {res.iterations} route iterations, overuse "
               f"trajectory {[s.overused_nodes for s in res.stats]}, "

@@ -259,7 +259,8 @@ def test_ladder_levels_records_and_floor():
                               "pipeline": "pipelined",
                               "program": "aot",
                               "dtype": "bf16",
-                              "dispatch": "fused"}
+                              "dispatch": "fused",
+                              "mesh": "pallas_halo"}
     assert lad.step("pipeline", reason="poisoned dispatch")
     assert lad.level("pipeline") == 1
     assert lad.name("pipeline") == "sync"
@@ -270,7 +271,7 @@ def test_ladder_levels_records_and_floor():
     assert v["route.resil.level.kernel"] == 0
     assert v["route.resil.degradation_steps"] == 2
     assert set(DIMS) == {"kernel", "pipeline", "program", "dtype",
-                         "dispatch"}
+                         "dispatch", "mesh"}
 
 
 # ---- queue backoff vs deadline (fake clock; no jax) ----------------

@@ -281,6 +281,32 @@ def check_row(fresh: dict, prev: dict, nets_tol: float) -> tuple:
                     f"row devcost measured/modeled bytes "
                     f"{dc.get('bytes_delta')} outside the declared "
                     f"1e±{dc.get('delta_band_log10')} band")
+    me, mn = check_mesh_row(fresh)
+    errs += me
+    notes += mn
+    return errs, notes
+
+
+def check_mesh_row(row) -> tuple:
+    """Mesh-consistency rule: a row whose metric snapshot claims halo
+    traffic (route.mesh.halo_bytes > 0) must also record a multi-shard
+    mesh — the SCHEMA v2 optional ``n_shards`` field or the
+    ``route.mesh.n_shards`` gauge, > 1.  Halo bytes on a
+    single-device run means the byte ledger is lying (or the mesh
+    demoted and the booking didn't follow)."""
+    errs, notes = [], []
+    if not isinstance(row, dict):
+        return errs, notes
+    g = row.get("gauges") or {}
+    hb = g.get("route.mesh.halo_bytes") or 0
+    ns = row.get("n_shards") or g.get("route.mesh.n_shards") or 1
+    if hb > 0:
+        if ns <= 1:
+            errs.append(f"mesh: route.mesh.halo_bytes {hb} > 0 but "
+                        f"n_shards {ns} — halo traffic recorded on a "
+                        f"single-device run")
+        else:
+            notes.append(f"mesh: halo_bytes {hb} with n_shards {ns} ok")
     return errs, notes
 
 
@@ -293,6 +319,11 @@ def check_corpus_scenario(rs, records: list, nets_tol: float,
     skip-note, not a failure — the corpus has to be allowed to grow."""
     errs, notes = [], []
     fresh = records[-1]
+    # consistency rules on the fresh row itself run even when there is
+    # no trajectory yet (a first mesh run must already be coherent)
+    me, mn = check_mesh_row(fresh)
+    errs += me
+    notes += mn
     backend = _row_backend(fresh)
     hist = rs.latest_same_backend(records[:-1], backend, k)
     hist = [r for r in hist if r.get("metric") == fresh.get("metric")]
@@ -410,7 +441,11 @@ def check_resil(doc: dict) -> tuple:
     # dtype ladder dimension (router._dtype_band_ok) — a legitimate,
     # counted cause for a degradation step
     dtyped = vals.get("route.kernel.dtype_demotions") or 0
-    causes = inj + wdt + derr + dtyped
+    # a lost mesh member demotes the mesh ladder dimension to
+    # single_chip (router._mesh_demote) — like dtype_demotions, a
+    # legitimate, counted cause for quarantine/degradation steps
+    meshd = vals.get("route.mesh.mesh_demotions") or 0
+    causes = inj + wdt + derr + dtyped + meshd
     q = g("quarantined_variants")
     ret = g("retries")
     cap = g("retry_cap")
