@@ -32,6 +32,9 @@ MetricsRegistry.enabled), like the reference's compiled-out log macros
 from .devprof import DevProfiler, get_devprof, set_devprof
 from .metrics import (COUNTER_TRACKS, Counter, Gauge, Histogram,
                       MetricsRegistry, get_metrics, set_metrics)
+from .slo import (CapacityForecaster, QuantileDigest, SLOPlane,
+                  SLOTracker, load_objectives, merge_slo_sections,
+                  slo_name)
 from .trace import (Tracer, compile_seconds, enable_compile_capture,
                     get_tracer, reset_compile_seconds, set_tracer,
                     span, stage)
@@ -43,4 +46,6 @@ __all__ = [
     "Tracer", "compile_seconds", "enable_compile_capture",
     "get_tracer", "reset_compile_seconds", "set_tracer", "span",
     "stage",
+    "CapacityForecaster", "QuantileDigest", "SLOPlane", "SLOTracker",
+    "load_objectives", "merge_slo_sections", "slo_name",
 ]

@@ -64,10 +64,20 @@ REQUIRED_FIELDS = (
 OPTIONAL_STR_FIELDS = ("tenant", "job_id", "plane_dtype")
 
 # optional int fields, same contract: the device-mesh shard count a
-# multi-chip run relaxed with (scale_bench --mesh).  Absent means 1 —
-# a single-device row written before (or without) the mesh era is the
-# same shape as always, so MULTICHIP_* rows mix with BENCH_* readers.
-OPTIONAL_INT_FIELDS = ("n_shards",)
+# multi-chip run relaxed with (scale_bench --mesh), and the number of
+# fleet failovers a served job survived (daemon-stamped).  Absent
+# means 1 shard / unknown failovers — a single-device row written
+# before (or without) the mesh era is the same shape as always, so
+# MULTICHIP_* rows mix with BENCH_* readers.
+OPTIONAL_INT_FIELDS = ("n_shards", "n_failovers")
+
+# optional float fields: the per-job latency columns the route daemon
+# stamps on serve-corpus rows (obs/slo.py) — queue wait from admission
+# to first slice, and end-to-end latency measured at record time.
+# Absent ⇒ unknown: v1/v2 rows written before the SLO era (and rows
+# from non-daemon serving) stay valid, and the observatory's latency
+# columns render "-" for them.
+OPTIONAL_FLOAT_FIELDS = ("queue_wait_s", "e2e_s")
 
 _SCENARIO_OK = re.compile(r"[^A-Za-z0-9._-]+")
 
@@ -122,7 +132,10 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
                 tenant: Optional[str] = None,
                 job_id: Optional[str] = None,
                 plane_dtype: Optional[str] = None,
-                n_shards: Optional[int] = None) -> dict:
+                n_shards: Optional[int] = None,
+                queue_wait_s: Optional[float] = None,
+                e2e_s: Optional[float] = None,
+                n_failovers: Optional[int] = None) -> dict:
     rec = {
         "schema_version": SCHEMA_VERSION,
         "ts": ts or now_iso(),
@@ -143,6 +156,12 @@ def make_record(scenario: str, cfg: dict, metric: str, value,
         rec["plane_dtype"] = str(plane_dtype)
     if n_shards is not None:
         rec["n_shards"] = int(n_shards)
+    if queue_wait_s is not None:
+        rec["queue_wait_s"] = float(queue_wait_s)
+    if e2e_s is not None:
+        rec["e2e_s"] = float(e2e_s)
+    if n_failovers is not None:
+        rec["n_failovers"] = int(n_failovers)
     for key, val in (("qor", qor), ("gauges", gauges),
                      ("series", series), ("congestion", congestion),
                      ("detail", detail), ("tags", tags)):
@@ -179,6 +198,11 @@ def validate_record(rec) -> list:
                             or isinstance(rec[name], bool)):
             errs.append(f"field {name!r} has type "
                         f"{type(rec[name]).__name__}, wanted int")
+    for name in OPTIONAL_FLOAT_FIELDS:
+        if name in rec and (not isinstance(rec[name], (int, float))
+                            or isinstance(rec[name], bool)):
+            errs.append(f"field {name!r} has type "
+                        f"{type(rec[name]).__name__}, wanted number")
     sv = rec.get("schema_version")
     if isinstance(sv, int) and sv > SCHEMA_VERSION:
         errs.append(f"schema_version {sv} is newer than this reader's "

@@ -58,7 +58,9 @@ from dataclasses import dataclass, replace as dc_replace
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs.metrics import get_metrics
-from ..obs.trace import FlightRecorder, get_tracer
+from ..obs.slo import (CapacityForecaster, SLOPlane, load_objectives,
+                       slo_name)
+from ..obs.trace import FlightRecorder, compile_seconds, get_tracer
 from ..route.router import RouterOpts
 from .queue import JobState, RouteJob
 from .service import RouteService, ServeJobSpec
@@ -132,6 +134,12 @@ class DaemonOpts:
     #                                (empty = no shard; the tracer
     #                                itself is installed by the CLI)
     flight_capacity: int = 256     # flight-recorder ring depth
+    # ---- SLO plane (obs/slo.py)
+    objectives_path: str = ""      # per-tenant objectives JSON (the
+    #                                traffic_gen --objectives fixture)
+    slo_window: int = 512          # error-budget rolling window (jobs)
+    slo_horizon_s: float = 60.0    # capacity forecaster drain target
+    slo_max_workers: int = 64      # recommended_workers cap
 
 
 def submit_job(inbox_dir: str, spec: dict, tenant: str = "default",
@@ -422,6 +430,17 @@ class RouteDaemon:
         service.flight = self.recorder
         self._telemetry_path = os.path.join(
             inbox_dir, telemetry_name(self.worker))
+        # SLO plane: waterfalls + digests + error budgets, fed from
+        # THIS daemon's injectable clock only, published at the same
+        # slice-boundary snapshot sites as the telemetry document
+        self.slo = SLOPlane(
+            objectives=load_objectives(self.opts.objectives_path),
+            window=self.opts.slo_window)
+        self.forecaster = CapacityForecaster(
+            horizon_s=self.opts.slo_horizon_s,
+            max_workers=self.opts.slo_max_workers)
+        self._slo_path = os.path.join(
+            inbox_dir, slo_name(self.worker))
         self.last_verdicts: List[dict] = []   # bounded, newest last
         self._last_slice: Optional[dict] = None
         self._terminal_seen: set = set()
@@ -671,6 +690,14 @@ class RouteDaemon:
             return
         job.scratch["nets"] = nets
         self._subs[job_id] = dict(sub)
+        self.slo.observe_admit(job_id, tenant, self._clock(),
+                               lag_s=max(0.0, lag or 0.0),
+                               failover=failover)
+        # the service's corpus row stamps these at record time (absent
+        # for non-daemon serving: the fields are optional by schema)
+        job.scratch["slo_fields"] = (
+            lambda jid=job_id: self.slo.runstore_fields(
+                jid, now=self._clock()))
         if failover:
             # the batch scheduler reads this to stamp the job's
             # rebatch-entry cause as "failover" rather than "join"
@@ -720,9 +747,18 @@ class RouteDaemon:
         share = max(self.opts.fair_share_floor,
                     int(self.opts.fair_share_frac * len(queued)))
 
+        # snapshot the backlog the victim ORDER was computed against:
+        # the loop below recomputes backlog_s after each eviction (its
+        # stop condition must see the shrinking queue), and doomed()
+        # closing over that shrinking value would let the shed cause's
+        # "deadline already infeasible" annotation disagree with the
+        # ordering that picked the victim
+        backlog_s0 = backlog_s
+
         def doomed(j: RouteJob) -> bool:
             return (j.deadline_s is not None
-                    and backlog_s > j.deadline_s - (now - j.admitted_t))
+                    and backlog_s0 > j.deadline_s
+                    - (now - j.admitted_t))
 
         victims = sorted(
             queued,
@@ -842,6 +878,33 @@ class RouteDaemon:
         if f is not None:
             self.lease.force_expire(held[0])
 
+    # ------------------------------------------- slice SLO sampling
+
+    def _stall_seconds(self) -> float:
+        """The pipeline's blocked time within the LAST route() call
+        (a per-slice gauge the router resets each invocation)."""
+        v = get_metrics().gauge("route.pipeline.stall_ms_total").value
+        return float(v) / 1e3 if isinstance(v, (int, float)) else 0.0
+
+    def _slice_marks(self) -> Tuple[float, float, float]:
+        """Pre-slice readings the waterfall attributes against: the
+        daemon clock, the process compile-seconds accumulator, and the
+        pipeline stall gauge — all host memory, no device sync."""
+        return self._clock(), compile_seconds(), self._stall_seconds()
+
+    def _observe_slice(self, job: RouteJob, t_start: float,
+                       compile0: float, stall0: float) -> None:
+        # the stall gauge is a per-route()-call TOTAL (the router
+        # resets it each invocation), so this slice's stall is the
+        # post-slice reading — unless the gauge never moved, i.e. the
+        # slice ran no pipelined windows at all
+        stall1 = self._stall_seconds()
+        self.slo.observe_slice(
+            job.job_id, t_start, self._clock(),
+            compile_s=max(0.0, compile_seconds() - compile0),
+            stall_s=stall1 if stall1 != stall0 else 0.0,
+            attempts=job.attempts)
+
     def _runner(self, job: RouteJob):
         """Queue runner: the service's, plus lease bookkeeping — a
         finished job releases terminally, a preempted one renews so a
@@ -849,6 +912,7 @@ class RouteDaemon:
         job's per-slice lifecycle span (the span records even when the
         slice raises: the queue's verdict loop owns the exception)."""
         tr = get_tracer()
+        t_start, c0, s0 = self._slice_marks()
         if tr is None:
             verdict, value = self.service._runner(job)
         else:
@@ -856,6 +920,7 @@ class RouteDaemon:
                          job_id=job.job_id, slice=job.slices + 1,
                          worker=self.worker or "solo"):
                 verdict, value = self.service._runner(job)
+        self._observe_slice(job, t_start, c0, s0)
         self._last_slice = {"job_id": job.job_id,
                             "slice": job.slices + 1, "verdict": verdict}
         self.last_verdicts.append(
@@ -877,6 +942,7 @@ class RouteDaemon:
         same per-job verdict/lease bookkeeping ``_runner`` does."""
         tr = get_tracer()
         ids = ",".join(j.job_id for j in jobs)
+        t_start, c0, s0 = self._slice_marks()
         if tr is None:
             verdicts = self.service._batch_runner(jobs)
         else:
@@ -885,6 +951,12 @@ class RouteDaemon:
                          slice=max(j.slices for j in jobs),
                          worker=self.worker or "solo"):
                 verdicts = self.service._batch_runner(jobs)
+        for job in jobs:
+            # lockstep costs are joint: every member LIVED through the
+            # whole fused wall, so each job's waterfall is charged the
+            # full slice window (per-job nets/s attribution stays the
+            # service's even-share route_s policy)
+            self._observe_slice(job, t_start, c0, s0)
         for job in jobs:
             verdict = verdicts.get(job.job_id, ("failed", ""))[0]
             self._last_slice = {"job_id": job.job_id,
@@ -967,6 +1039,12 @@ class RouteDaemon:
         hand, so building it never forces a device sync mid-window."""
         q = self.service.queue
         m = get_metrics()
+        fc = self._forecast()
+        # publish the route.slo.* gauges BEFORE the registry snapshot
+        # so the metrics map and the slo section always agree (the
+        # plane returns unprefixed keys; the daemon owns the namespace)
+        for k, v in self.slo.gauges(fc).items():
+            m.gauge("route.slo." + k).set(v)
         doc = {"schema": 1, "worker": self.worker,
                "ts": round(self._wall(), 3),
                "mono": round(self._clock(), 3),
@@ -978,8 +1056,22 @@ class RouteDaemon:
                "held_leases": (self.lease.held()
                                if self.lease is not None else []),
                "last_verdicts": list(self.last_verdicts),
+               "slo": self.slo.snapshot(forecast=fc),
                "metrics": m.values("route.")}
         return doc
+
+    def _forecast(self) -> dict:
+        """Capacity forecast from the LAST published capacity gauge
+        (refreshed only when admission/shedding has not priced it this
+        run — never an extra corpus read per snapshot) and the live
+        backlog.  workers_alive=1: a worker forecasts draining ITS OWN
+        backlog; the fleet merge re-derives the fleet view."""
+        rate = get_metrics().gauge(
+            "route.daemon.capacity_nets_per_s").value
+        if not isinstance(rate, (int, float)) or rate <= 0:
+            rate = self.admission.capacity_nets_per_s()
+        return self.forecaster.forecast(
+            rate, self._backlog_nets(), workers_alive=1)
 
     def _write_telemetry(self) -> None:
         """Atomic snapshot publish (tmp + os.replace): a scraper can
@@ -988,11 +1080,18 @@ class RouteDaemon:
         durability (stale-after-crash is fine; a per-cycle fsync is
         not)."""
         try:
+            doc = self.live_snapshot()
             tmp = self._telemetry_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(self.live_snapshot(), f, sort_keys=True,
-                          default=str)
+                json.dump(doc, f, sort_keys=True, default=str)
             os.replace(tmp, self._telemetry_path)
+            # the slo.json twin rides the SAME publish site (and the
+            # same snapshot counter): SLO publishing adds no snapshot
+            # sites and no mid-window syncs
+            tmp = self._slo_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc["slo"], f, sort_keys=True, default=str)
+            os.replace(tmp, self._slo_path)
         except OSError as e:
             get_metrics().counter(
                 "route.daemon.snapshot_errors").inc()
@@ -1010,6 +1109,10 @@ class RouteDaemon:
                     or j.state in (JobState.QUEUED, JobState.RUNNING):
                 continue
             self._terminal_seen.add(j.job_id)
+            # finalize the job's latency waterfall + digest samples
+            # (exactly one per terminal job — the doctor's count rule)
+            self.slo.observe_terminal(j.job_id, j.state.value,
+                                      self._clock())
             if tr is not None:
                 tr.instant("route.trace.terminal", cat="lifecycle",
                            job_id=j.job_id, state=j.state.value,
@@ -1196,6 +1299,7 @@ class RouteDaemon:
             "scenario": self.service.scenario,
             "jobs": jobs,
             "fleet": fleet,
+            "slo": self.slo.snapshot(forecast=self._forecast()),
             "daemon": {
                 "inbox": {"dir": self.inbox_dir,
                           "consumed_bytes": self.reader.offset,

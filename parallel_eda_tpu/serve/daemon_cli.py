@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "trace-event JSON, atomically re-exported every "
                    "cycle: job lifecycle spans + clock-sync beacons; "
                    "tools/trace_merge.py aligns shards fleet-wide)")
+    r.add_argument("--objectives", default="",
+                   help="per-tenant SLO objectives JSON (the "
+                   "traffic_gen --objectives fixture): arms error-"
+                   "budget burn tracking in the slo.json snapshot "
+                   "flow_doctor --slo gates")
 
     s = sub.add_parser("submit", help="submit one synthetic job")
     s.add_argument("--inbox", required=True)
@@ -186,6 +191,10 @@ def build_parser() -> argparse.ArgumentParser:
                    "beacon-aligns them into <inbox>/trace.merged.json "
                    "— one Perfetto timeline, one track per worker, "
                    "job flows connected across failovers")
+    f.add_argument("--objectives", default="",
+                   help="per-tenant SLO objectives JSON, forwarded to "
+                   "every worker; the fleet summary carries the "
+                   "merged digests + per-tenant burn")
     return p
 
 
@@ -217,7 +226,8 @@ def _cmd_run(args) -> int:
         worker=worker, workers=roster,
         lease_ttl_s=args.lease_ttl_s,
         foreign_grace_s=args.foreign_grace_s,
-        trace_path=trace_path)
+        trace_path=trace_path,
+        objectives_path=getattr(args, "objectives", ""))
     plan = None
     if args.chaos:
         from ..resil.faults import FaultPlan
@@ -390,7 +400,8 @@ def _cmd_fleet(args) -> int:
         transport=not args.no_transport,
         host=args.host, port=args.port,
         expect_jobs=args.expect_jobs, tick_s=args.tick_s,
-        trace=args.trace)
+        trace=args.trace,
+        objectives_path=getattr(args, "objectives", ""))
     sup = FleetSupervisor(args.inbox, opts)
     summary = sup.run(timeout_s=args.timeout_s)
     blob = json.dumps(summary, default=str)

@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..obs.metrics import get_metrics
+from ..obs.slo import CapacityForecaster, merge_slo_sections
 from ..resil.journal import Heartbeat, LeaseStore, _atomic_write_json
 from .daemon import LEASE_DIR, DRAIN_NAME, heartbeat_name, telemetry_name
 from .transport import InboxHTTPServer
@@ -97,6 +98,8 @@ class FleetOpts:
     #                                fleet trace (trace.merged.json)
     skew_bound_ms: float = 250.0   # declared post-align residual-skew
     #                                bound the fleet doctor gates
+    objectives_path: str = ""      # per-tenant SLO objectives JSON,
+    #                                forwarded to every worker
     extra_worker_args: List[str] = field(default_factory=list)
 
 
@@ -170,6 +173,8 @@ class FleetSupervisor:
                     "--chaos_seed", str(o.chaos_seed)]
         if o.trace:
             cmd += ["--trace", self._shard_path(worker)]
+        if o.objectives_path:
+            cmd += ["--objectives", o.objectives_path]
         return cmd + list(o.extra_worker_args)
 
     def start(self) -> "FleetSupervisor":
@@ -397,6 +402,30 @@ class FleetSupervisor:
                 "residual_skew_ms": meta.get("residual_skew_ms"),
                 "skew_bound_ms": meta.get("skew_bound_ms")}
 
+    def _merge_slo(self, sections: Dict[str, dict]) -> Optional[dict]:
+        """Bin-wise exact merge of every worker's SLO section (the
+        merged digest count equals the sum of the shard counts by
+        construction — flow_doctor --slo asserts it), plus a
+        fleet-level capacity forecast re-derived from the workers'
+        published forecast inputs: summed backlog, mean per-worker
+        rate, and the supervisor's own workers_alive reading."""
+        if not sections:
+            return None
+        fcs = [s.get("forecast") for s in sections.values()
+               if isinstance(s.get("forecast"), dict)]
+        forecast = None
+        if fcs:
+            rates = [float(f.get("rate_nets_per_s") or 0.0)
+                     for f in fcs]
+            forecast = CapacityForecaster(
+                horizon_s=float(fcs[0].get("horizon_s") or 60.0),
+                max_workers=int(fcs[0].get("max_workers") or 64),
+            ).forecast(
+                sum(rates) / max(1, len(rates)),
+                sum(float(f.get("backlog_nets") or 0.0) for f in fcs),
+                workers_alive=max(1, len(self.alive_workers())))
+        return merge_slo_sections(sections, forecast=forecast)
+
     def summary(self, serve_wall_s: float = 0.0) -> dict:
         """The ``flow_doctor --fleet-summary`` artifact: merged job
         rows (worker-attributed), fleet-wide route.fleet.* metrics
@@ -406,6 +435,7 @@ class FleetSupervisor:
         merged: Dict[str, float] = dict(
             get_metrics().values("route.fleet."))
         per_worker: Dict[str, dict] = {}
+        slo_sections: Dict[str, dict] = {}
         for w in self.roster:
             doc = self._worker_summary(w)
             row = {"worker": w,
@@ -417,6 +447,8 @@ class FleetSupervisor:
             if doc is None:
                 continue
             jobs.extend(doc.get("jobs") or [])
+            if isinstance(doc.get("slo"), dict):
+                slo_sections[w] = doc["slo"]
             rb = doc.get("rebatch") or {}
             if rb.get("fused"):
                 row["rebatch"] = {"rounds": rb.get("rounds", 0),
@@ -438,6 +470,7 @@ class FleetSupervisor:
         # a gauge is a point-in-time reading, not summable: report the
         # supervisor's own final observation
         merged["route.fleet.workers_alive"] = len(self.alive_workers())
+        fleet_slo = self._merge_slo(slo_sections)
         leases = {j: {"worker": d.get("worker"),
                       "state": d.get("state"),
                       "generation": d.get("generation"),
@@ -448,6 +481,7 @@ class FleetSupervisor:
         return {
             "scenario": self.opts.scenario or "fleet",
             "jobs": jobs,
+            "slo": fleet_slo,
             "fleet": {
                 "inbox": self.inbox_dir,
                 "roster": self.roster,

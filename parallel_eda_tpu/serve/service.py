@@ -413,6 +413,21 @@ class RouteService:
                     os.write(fd, b'\x80\xfe{"torn": tr\n')
                 finally:
                     os.close(fd)
+        # optional latency columns (runstore SCHEMA v2): the daemon
+        # injects a provider via job.scratch; absent means unknown —
+        # a plain serve() run writes the same row shape as ever
+        slo_fields = job.scratch.get("slo_fields")
+        if callable(slo_fields):
+            try:
+                slo_fields = slo_fields()
+            except Exception:
+                # a latency stamp must never block the corpus append;
+                # the row is written without the optional columns
+                get_metrics().counter(
+                    "route.serve.slo_stamp_errors").inc()
+                slo_fields = None
+        if not isinstance(slo_fields, dict):
+            slo_fields = {}
         rec = make_record(
             scenario=self.scenario,
             cfg={**self.cfg, "job": spec.name, "tenant": job.tenant},
@@ -426,7 +441,10 @@ class RouteService:
                     **get_metrics().values("route.resil.")},
             detail=dict(preemptions=job.preemptions,
                         slices=job.slices, **spec.detail),
-            tenant=job.tenant, job_id=job.job_id)
+            tenant=job.tenant, job_id=job.job_id,
+            queue_wait_s=slo_fields.get("queue_wait_s"),
+            e2e_s=slo_fields.get("e2e_s"),
+            n_failovers=slo_fields.get("n_failovers"))
         append_run(self.runs_dir, rec)
 
     # --------------------------------------------------------- run

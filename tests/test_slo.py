@@ -1,0 +1,714 @@
+"""Fleet SLO plane: streaming digests, latency waterfalls, error
+budgets, and the capacity forecaster.
+
+Five layers:
+
+* digest units — determinism, exact bin-wise merge under skewed
+  fake clocks (merged count == sum of shards, bin for bin), wire
+  round-trip, parameter/count tamper detection;
+* waterfall units — the integer-microsecond telescoping identity
+  (stage sum reconstructs e2e EXACTLY) across plain, retry-backoff
+  and failover shapes, plus the runstore stamping fields;
+* daemon loop — a fake-clock RouteDaemon publishes the slo section
+  in telemetry + slo.json at the existing snapshot sites (witnessed
+  by route.daemon.snapshot_writes staying the ONLY write counter),
+  route.slo.* gauges, and corpus rows carrying the optional latency
+  columns; the _shed_overload annotation agrees with victim order;
+* fleet merge + forecaster — merge_slo_sections over skewed worker
+  shards, worst-burn/breach-union semantics, forecast re-derivation;
+* gates — flow_doctor --slo passes a healthy summary and FAILS
+  tampered waterfalls / hidden breaches / merge drift; trace_report's
+  lifecycle-coverage rule; traffic_gen --objectives determinism;
+  observatory latency columns; runstore row compatibility.
+
+    python -m pytest tests/ -m slo
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+from parallel_eda_tpu.obs import MetricsRegistry, get_metrics, set_metrics
+from parallel_eda_tpu.obs.slo import (STAGES, CapacityForecaster,
+                                      QuantileDigest, SLOPlane,
+                                      SLOTracker, load_objectives,
+                                      merge_slo_sections,
+                                      recommended_workers, slo_name,
+                                      waterfall_exact)
+from parallel_eda_tpu.obs.trace import set_tracer
+from parallel_eda_tpu.serve.daemon import (DaemonOpts, RouteDaemon,
+                                           submit_job)
+from parallel_eda_tpu.serve.queue import JobQueue, JobState, RouteJob
+
+pytestmark = pytest.mark.slo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    set_metrics(MetricsRegistry())
+    set_tracer(None)
+    yield
+    set_metrics(MetricsRegistry())
+    set_tracer(None)
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(TOOLS, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeFlow:
+    def __init__(self, nets):
+        self.term = types.SimpleNamespace(source=list(range(nets)))
+
+
+class _FakeService:
+    def __init__(self, clock, runner=None):
+        self.queue = JobQueue(clock=clock, sleep=lambda s: None)
+        self.draining = False
+        self.runs_dir = None
+        self.scenario = "slo-fake"
+        self.router = types.SimpleNamespace(_library=None)
+        self.resil = None
+        self.diag_extra = None
+        self.runner = runner or (
+            lambda job: ("done", {"wirelength": 7, "iterations": 2,
+                                  "nets": len(job.payload.term.source)}))
+
+    def begin_drain(self):
+        self.draining = True
+
+    def admit(self, spec, tenant="default", priority=0,
+              deadline_s=None, max_retries=0, job_id=""):
+        if self.draining:
+            raise RuntimeError("service is draining")
+        job = RouteJob(tenant=tenant, payload=spec, job_id=job_id,
+                       priority=priority, deadline_s=deadline_s,
+                       max_retries=max_retries)
+        return self.queue.admit(job)
+
+    def _runner(self, job):
+        return self.runner(job)
+
+
+def _mk_daemon(tmp_path, clock=None, opts=None, runner=None):
+    clock = clock or _Clock()
+    svc = _FakeService(clock, runner=runner)
+    d = RouteDaemon(
+        svc, str(tmp_path / "box"),
+        opts or DaemonOpts(default_nets_per_s=10.0,
+                           cold_start_factor=1.0, exit_when_idle=1),
+        flow_builder=lambda spec: _FakeFlow(int(spec.get("nets", 10))),
+        clock=clock, wall=lambda: 1000.0 + clock.t,
+        sleep=lambda s: setattr(clock, "t", clock.t + s))
+    return d, svc, clock
+
+
+# ---- digest units ---------------------------------------------------
+
+def test_digest_deterministic_and_order_independent():
+    a, b = QuantileDigest(), QuantileDigest()
+    xs = [0.001, 0.5, 0.5, 3.0, 42.0, 1e-6, 2e6]  # incl. under/overflow
+    for x in xs:
+        a.add(x)
+    for x in reversed(xs):
+        b.add(x)
+    assert a.counts == b.counts and a.count == len(xs)
+    assert a.to_dict() == b.to_dict()
+    # quantiles are covering-bin upper edges: monotone, conservative
+    assert a.quantile(0.0) <= a.quantile(0.5) <= a.quantile(1.0)
+    assert a.quantile(0.5) >= 0.5
+    assert QuantileDigest().quantile(0.95) == 0.0
+
+
+def test_digest_merge_is_exact_bin_sum():
+    # two "workers" with skewed fake clocks feed different samples;
+    # the merged digest must equal bin-for-bin the digest that saw
+    # every sample itself — the merge invents and loses NOTHING
+    w0, w1, ref = QuantileDigest(), QuantileDigest(), QuantileDigest()
+    for i in range(100):
+        v = 0.01 * (i + 1)
+        w0.add(v)
+        ref.add(v)
+    for i in range(37):
+        v = 10.0 + 1000.0 * i      # wildly different latency regime
+        w1.add(v)
+        ref.add(v)
+    merged = QuantileDigest.from_dict(w0.to_dict())
+    merged.merge(QuantileDigest.from_dict(w1.to_dict()))
+    assert merged.count == w0.count + w1.count == 137
+    assert merged.counts == ref.counts
+    assert merged.quantile(0.95) == ref.quantile(0.95)
+
+
+def test_digest_wire_format_rejects_tampering():
+    d = QuantileDigest()
+    for v in (0.1, 1.0, 10.0):
+        d.add(v)
+    doc = d.to_dict()
+    rt = QuantileDigest.from_dict(doc)
+    assert rt.counts == d.counts and rt.count == 3
+    # declared count disagreeing with the bin sum is a hard error
+    bad = dict(doc, count=5)
+    with pytest.raises(ValueError, match="count 5 != bin sum"):
+        QuantileDigest.from_dict(bad)
+    # parameter mismatch refuses to merge (bins are incompatible)
+    with pytest.raises(ValueError, match="parameter mismatch"):
+        d.merge(QuantileDigest(bins_per_decade=4))
+    with pytest.raises(ValueError):
+        QuantileDigest(lo=1.0, hi=2.0)    # not a whole bin span
+
+
+# ---- waterfall units ------------------------------------------------
+
+def test_waterfall_exact_plain_job():
+    p = SLOPlane()
+    p.observe_admit("j", "t0", 10.0, lag_s=0.25)
+    p.observe_slice("j", 12.0, 13.0, compile_s=0.4, stall_s=0.1)
+    p.observe_slice("j", 13.5, 14.0)
+    wf = p.observe_terminal("j", "done", 14.2)
+    assert waterfall_exact(wf)
+    st = wf["stages_us"]
+    assert sum(st.values()) == wf["e2e_us"] == 4_450_000
+    assert st["queue_wait"] == 2_250_000   # admit->first slice + lag
+    assert st["compile"] == 400_000 and st["stall"] == 100_000
+    assert st["exec"] == 1_000_000         # slice wall minus compile/stall
+    assert st["failover_gap"] == 0 and st["backoff"] == 0
+    assert st["other"] == 700_000          # inter-slice + post-slice tail
+    assert set(st) == set(STAGES)
+    # exactly one digest sample per terminal job
+    assert p.digest_e2e.count == 1
+    assert p.observe_terminal("j", "done", 15.0) is None
+    assert p.untracked_terminals == 1      # double-terminal is counted
+
+
+def test_waterfall_exact_failover_and_backoff():
+    p = SLOPlane()
+    # failover re-admission: the 2s inbox lag is the orphaned window,
+    # its own stage — NOT queue wait
+    p.observe_admit("j", "t0", 100.0, lag_s=2.0, failover=True)
+    p.observe_slice("j", 101.0, 102.0, attempts=0)
+    # a retry slice after a 3s hold: the gap is backoff
+    p.observe_slice("j", 105.0, 106.0, attempts=1)
+    wf = p.observe_terminal("j", "failed", 106.0)
+    assert waterfall_exact(wf)
+    st = wf["stages_us"]
+    assert st["failover_gap"] == 2_000_000
+    assert st["queue_wait"] == 1_000_000
+    assert st["backoff"] == 3_000_000
+    assert wf["n_failovers"] == 1 and wf["n_slices"] == 2
+    # compile charged beyond the slice wall is clamped, identity holds
+    p2 = SLOPlane()
+    p2.observe_admit("k", "t0", 0.0)
+    p2.observe_slice("k", 0.0, 1.0, compile_s=9.0, stall_s=9.0)
+    wf2 = p2.observe_terminal("k", "done", 1.0)
+    assert waterfall_exact(wf2)
+    assert wf2["stages_us"]["compile"] == 1_000_000
+    assert wf2["stages_us"]["stall"] == 0
+    # a zero-slice shed job still telescopes (queue wait is everything)
+    p3 = SLOPlane()
+    p3.observe_admit("s", "t0", 0.0, lag_s=0.5)
+    wf3 = p3.observe_terminal("s", "shed", 4.5)
+    assert waterfall_exact(wf3)
+    assert wf3["stages_us"]["queue_wait"] == 5_000_000 == wf3["e2e_us"]
+
+
+def test_waterfall_exact_gate_catches_tampering():
+    p = SLOPlane()
+    p.observe_admit("j", "t0", 0.0)
+    p.observe_slice("j", 1.0, 2.0)
+    wf = p.observe_terminal("j", "done", 2.0)
+    assert waterfall_exact(wf)
+    assert not waterfall_exact({**wf, "e2e_us": wf["e2e_us"] + 1})
+    missing = {**wf, "stages_us": {k: v for k, v in
+                                   wf["stages_us"].items()
+                                   if k != "other"}}
+    assert not waterfall_exact(missing)
+    floaty = {**wf, "stages_us": dict(wf["stages_us"], exec=1.0e6)}
+    assert not waterfall_exact(floaty)
+
+
+def test_runstore_fields_live_and_unknown():
+    p = SLOPlane()
+    p.observe_admit("j", "t0", 10.0, lag_s=0.5)
+    p.observe_slice("j", 12.0, 13.0)
+    f = p.runstore_fields("j", now=13.0)
+    assert f == {"queue_wait_s": 2.5, "e2e_s": 3.5, "n_failovers": 0}
+    assert p.runstore_fields("nope", now=13.0) == {}  # unknown => absent
+
+
+# ---- tracker / error budgets ---------------------------------------
+
+def test_tracker_burn_breach_iff_over_one():
+    tr = SLOTracker("t0", {"e2e_p95_s": 1.0, "failure_rate": 0.10,
+                           "budget_frac": 0.05}, window=100)
+    for _ in range(18):
+        tr.observe(0.5, 0.0, failed=False)   # within objective
+    tr.observe(2.0, 0.0, failed=False)       # 1/19 over: burn > 1
+    snap = tr.snapshot()
+    assert snap["burn"]["e2e_p95_s"] > 1.0
+    assert snap["breached"] == ["e2e_p95_s"]
+    assert snap["burn_max"] == max(snap["burn"].values())
+    tr.observe(0.1, 0.0, failed=True)        # 1/20 failed = the budget
+    snap = tr.snapshot()
+    assert snap["burn"]["failure_rate"] <= 1.0
+    assert "failure_rate" not in snap["breached"]
+    # burn > 1 and breached are DEFINITIONALLY the same set
+    for k, v in snap["burn"].items():
+        assert (v > 1.0) == (k in snap["breached"])
+    # no objectives -> no burn, nothing breached
+    free = SLOTracker("t1")
+    free.observe(9999.0, 9999.0, failed=True)
+    assert free.snapshot()["burn"] == {} \
+        and free.snapshot()["breached"] == []
+
+
+def test_load_objectives_shapes(tmp_path):
+    fix = tmp_path / "obj.json"
+    fix.write_text(json.dumps({"schema": 1, "tenants": {
+        "t0": {"e2e_p95_s": 30.0, "bogus": "x"}}}))
+    assert load_objectives(str(fix)) == {"t0": {"e2e_p95_s": 30.0}}
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"t1": {"failure_rate": 0.1}}))
+    assert load_objectives(str(bare)) == {"t1": {"failure_rate": 0.1}}
+    assert load_objectives(str(tmp_path / "missing.json")) == {}
+    assert load_objectives("") == {}
+
+
+# ---- forecaster -----------------------------------------------------
+
+def test_forecaster_recommendation_re_derivable():
+    fc = CapacityForecaster(horizon_s=60.0, max_workers=8).forecast(
+        rate_nets_per_s=10.0, backlog_nets=3000.0, workers_alive=2)
+    assert fc["backlog_s"] == 300.0
+    assert fc["time_to_drain_s"] == 150.0
+    assert fc["recommended_workers"] == 5 == recommended_workers(
+        fc["backlog_s"], fc["horizon_s"], fc["max_workers"])
+    # empty backlog -> one worker, zero drain; cap binds the top
+    idle = CapacityForecaster().forecast(10.0, 0.0)
+    assert idle["recommended_workers"] == 1
+    assert idle["time_to_drain_s"] == 0.0
+    assert recommended_workers(1e9, 60.0, 8) == 8
+
+
+# ---- daemon loop ----------------------------------------------------
+
+def test_daemon_publishes_slo_at_snapshot_sites(tmp_path):
+    obj = tmp_path / "objectives.json"
+    obj.write_text(json.dumps({"tenants": {
+        "t0": {"e2e_p95_s": 0.001, "budget_frac": 0.05}}}))
+    d, svc, clock = _mk_daemon(
+        tmp_path, opts=DaemonOpts(default_nets_per_s=10.0,
+                                  cold_start_factor=1.0,
+                                  exit_when_idle=1,
+                                  objectives_path=str(obj)))
+    submit_job(d.inbox_dir, {"nets": 5, "name": "a"}, tenant="t0",
+               job_id="a")
+    submit_job(d.inbox_dir, {"nets": 5, "name": "b"}, tenant="t1",
+               job_id="b")
+    jobs = d.run()
+    assert sorted(j.state.value for j in jobs) == ["done", "done"]
+    s = d.summary()
+    slo = s["slo"]
+    assert slo["terminal_jobs"] == 2 == slo["digest_e2e"]["count"]
+    assert slo["untracked_terminals"] == 0
+    # every waterfall telescopes exactly
+    assert len(slo["waterfalls"]) == 2
+    for wf in slo["waterfalls"]:
+        assert waterfall_exact(wf)
+        assert wf["n_slices"] >= 1
+    # the fake clock only advances in sleep(), so every job breaches
+    # the absurd 1ms objective deterministically
+    t0 = slo["tenants"]["t0"]
+    assert t0["burn"]["e2e_p95_s"] > 1.0
+    assert t0["breached"] == ["e2e_p95_s"]
+    assert slo["tenants"]["t1"]["objectives"] is None
+    # forecast published with the recommendation re-derivable
+    fc = slo["forecast"]
+    assert fc["recommended_workers"] == recommended_workers(
+        fc["backlog_s"], fc["horizon_s"], fc["max_workers"])
+    # slo.json twin lands beside telemetry.json, same content shape,
+    # and the ONLY write counter that moved is the PR 13 snapshot one
+    # (no new write site = no new mid-window sync surface)
+    twin = json.load(open(os.path.join(d.inbox_dir, slo_name())))
+    assert twin["terminal_jobs"] == 2
+    assert all(waterfall_exact(wf) for wf in twin["waterfalls"])
+    v = get_metrics().values("route.daemon.")
+    assert v["route.daemon.snapshot_writes"] >= 1
+    assert not [k for k in v if "slo" in k]
+    # telemetry carries the same section + the route.slo.* gauges
+    tele = json.load(open(os.path.join(d.inbox_dir, "telemetry.json")))
+    assert tele["slo"]["terminal_jobs"] == 2
+    g = tele["metrics"]
+    assert g["route.slo.terminal_jobs"] == 2
+    assert g["route.slo.breaches"] >= 1
+    assert g["route.slo.e2e_p95_s"] >= g["route.slo.e2e_p50_s"] > 0
+    assert g["route.slo.recommended_workers"] >= 1
+    # and the whole summary passes the doctor's --slo rule set
+    fd = _tool("flow_doctor")
+    errs, notes = fd.check_slo(s)
+    assert errs == []
+    assert any("2 terminal job(s)" in n for n in notes)
+
+
+def test_daemon_corpus_rows_carry_latency_fields(tmp_path):
+    from parallel_eda_tpu.obs import runstore as rs
+    d, svc, clock = _mk_daemon(tmp_path)
+    rows = []
+
+    def _fake_finish(job):
+        f = job.scratch.get("slo_fields")
+        rows.append(f() if callable(f) else {})
+
+    # stand in for service._corpus_row's record time: inside the final
+    # slice, BEFORE the daemon's terminal scan
+    svc.runner = lambda job: (_fake_finish(job) or
+                              ("done", {"wirelength": 1,
+                                        "iterations": 1, "nets": 5}))
+    submit_job(d.inbox_dir, {"nets": 5, "name": "a"}, job_id="a")
+    d.run()
+    assert len(rows) == 1
+    r = rows[0]
+    assert set(r) == {"queue_wait_s", "e2e_s", "n_failovers"}
+    assert r["e2e_s"] >= r["queue_wait_s"] >= 0.0
+    assert r["n_failovers"] == 0
+    # the runstore accepts the stamped row AND the field-less old shape
+    rec = rs.make_record("s", {}, "nets_per_s", 1.0, "nets/s",
+                         "cpu", "cpu", queue_wait_s=r["queue_wait_s"],
+                         e2e_s=r["e2e_s"],
+                         n_failovers=r["n_failovers"])
+    assert rs.validate_record(rec) == []
+    assert rec["queue_wait_s"] == r["queue_wait_s"]
+    old = rs.make_record("s", {}, "nets_per_s", 1.0, "nets/s",
+                         "cpu", "cpu")
+    assert rs.validate_record(old) == []
+    assert "queue_wait_s" not in old and "e2e_s" not in old
+    bad = dict(rec, e2e_s="fast")
+    assert any("e2e_s" in e for e in rs.validate_record(bad))
+
+
+def test_shed_annotation_agrees_with_victim_order(tmp_path):
+    """The doomed() pin: the 'deadline already infeasible' annotation
+    must be judged against the SAME backlog snapshot the victim order
+    used — evictions shrinking the backlog mid-loop must not flip a
+    job annotated doomed back to feasible."""
+    opts = DaemonOpts(default_nets_per_s=10.0, cold_start_factor=1.0,
+                      admit_horizon_s=10.0, overload_factor=1.0,
+                      exit_when_idle=1)
+    d, svc, clock = _mk_daemon(tmp_path, opts=opts)
+    # backlog 3000 nets = 300s at 10 nets/s, far over the 10s horizon
+    for jid, deadline in (("big", None), ("dead1", 250.0),
+                          ("dead2", 290.0)):
+        job = RouteJob(tenant=f"tn-{jid}", payload=None, job_id=jid,
+                       deadline_s=deadline)
+        svc.queue.admit(job)
+        job.scratch["nets"] = 1000
+    shed = d._shed_overload()
+    assert shed == 3
+    # both deadline jobs were doomed AT ORDERING TIME (300s backlog >
+    # both deadlines).  After the first eviction the live backlog is
+    # 200s < 250s — the closure-rebinding bug would strip the second
+    # one's annotation while the order still treated it as doomed.
+    for jid in ("dead1", "dead2"):
+        assert "deadline already infeasible" in \
+            d.shed_causes[jid]["detail"], jid
+    assert "deadline already infeasible" not in \
+        d.shed_causes["big"]["detail"]
+    # doomed victims first, the no-deadline job last (shed_causes is
+    # insertion-ordered: the order evictions actually happened)
+    order = list(d.shed_causes)
+    assert set(order[:2]) == {"dead1", "dead2"}
+    assert order[2] == "big"
+
+
+# ---- fleet merge ----------------------------------------------------
+
+def _worker_section(offset, jobs, tenant="t0", objectives=None):
+    """One worker's slo section from its OWN skewed fake clock."""
+    p = SLOPlane(objectives={tenant: objectives} if objectives else None)
+    for i, e2e in enumerate(jobs):
+        jid = f"j{offset}-{i}"
+        p.observe_admit(jid, tenant, offset + i)
+        p.observe_slice(jid, offset + i + 0.1, offset + i + 0.1 + e2e)
+        p.observe_terminal(jid, "done", offset + i + 0.1 + e2e)
+    return p.snapshot()
+
+
+def test_fleet_merge_exact_under_skewed_clocks():
+    # worker clocks 1e6 seconds apart: irrelevant, because only
+    # DURATIONS feed the digests and the merge is a pure bin sum
+    s0 = _worker_section(0.0, [0.1, 0.2, 5.0],
+                         objectives={"e2e_p95_s": 1.0})
+    s1 = _worker_section(1e6, [0.1, 30.0],
+                         objectives={"e2e_p95_s": 1.0})
+    merged = merge_slo_sections({"w0": s0, "w1": s1})
+    assert merged["shards"] == {"w0": 3, "w1": 2}
+    assert merged["terminal_jobs"] == 5
+    assert merged["digest_e2e"]["count"] == 5
+    assert merged["errors"] is None
+    # bin-wise exactness: merged == a digest that saw all five jobs
+    # (each measured e2e is the admit->terminal span: e2e + the 0.1s
+    # admit->slice offset baked into _worker_section)
+    ref = QuantileDigest()
+    for e2e in (0.1, 0.2, 5.0, 0.1, 30.0):
+        ref.add(e2e + 0.1)
+    assert QuantileDigest.from_dict(
+        merged["digest_e2e"]).counts == ref.counts
+    # tenant view: worst per-worker burn + breach union + summed jobs
+    t0 = merged["tenants"]["t0"]
+    worst = max(s0["tenants"]["t0"]["burn_max"],
+                s1["tenants"]["t0"]["burn_max"])
+    assert t0["burn_max"] == worst > 1.0
+    assert t0["breached"] == ["e2e_p95_s"]
+    assert t0["counts"]["jobs"] == 5
+    assert t0["digest_e2e"]["count"] == 5
+    # and the merged section passes the doctor
+    fd = _tool("flow_doctor")
+    errs, _ = fd.check_slo({"slo": merged})
+    assert errs == []
+
+
+def test_fleet_merge_surfaces_incompatible_shards():
+    s0 = _worker_section(0.0, [0.1])
+    s1 = _worker_section(0.0, [0.2])
+    s1["digest_e2e"]["bins_per_decade"] = 4   # incompatible bins
+    del s1["digest_e2e"]["counts"]            # keep it parseable-ish
+    s1["digest_e2e"]["count"] = 0
+    merged = merge_slo_sections({"w0": s0, "w1": s1})
+    assert merged["errors"] and "fleet:e2e" in merged["errors"]
+    fd = _tool("flow_doctor")
+    errs, _ = fd.check_slo({"slo": merged})
+    assert any("merge error" in e for e in errs)
+
+
+# ---- doctor --slo gates --------------------------------------------
+
+def _healthy_summary():
+    p = SLOPlane(objectives={"t0": {"e2e_p95_s": 10.0}})
+    for i in range(4):
+        jid = f"j{i}"
+        p.observe_admit(jid, "t0", float(i))
+        p.observe_slice(jid, i + 0.5, i + 1.0)
+        p.observe_terminal(jid, "done", i + 1.0)
+    fc = CapacityForecaster(horizon_s=60.0, max_workers=8).forecast(
+        10.0, 0.0, workers_alive=1)
+    jobs = [{"job_id": f"j{i}", "state": "done"} for i in range(4)]
+    jobs.append({"job_id": "r", "state": "rejected"})  # not terminal
+    return {"jobs": jobs, "slo": p.snapshot(forecast=fc)}
+
+
+def test_doctor_slo_healthy_and_tampered():
+    fd = _tool("flow_doctor")
+    doc = _healthy_summary()
+    errs, notes = fd.check_slo(doc)
+    assert errs == []
+    assert any("daemon section" in n for n in notes)
+
+    # orphaned waterfall: a stage sum that no longer reconstructs e2e
+    bad = _healthy_summary()
+    bad["slo"]["waterfalls"][1]["stages_us"]["exec"] += 7
+    errs, _ = fd.check_slo(bad)
+    assert any("does not reconstruct" in e for e in errs)
+
+    # hidden breach: burn says spent, breached says fine
+    bad = _healthy_summary()
+    t = bad["slo"]["tenants"]["t0"]
+    t["burn"]["e2e_p95_s"] = 2.5
+    t["burn_max"] = 2.5
+    errs, _ = fd.check_slo(bad)
+    assert any("hiding" in e for e in errs)
+    # ...and the dual: a breach declared without the burn
+    bad2 = _healthy_summary()
+    t2 = bad2["slo"]["tenants"]["t0"]
+    t2["breached"] = ["e2e_p95_s"]
+    errs, _ = fd.check_slo(bad2)
+    assert any("false alarm" in e for e in errs)
+
+    # digest count drifting off terminal_jobs
+    bad = _healthy_summary()
+    bad["slo"]["terminal_jobs"] = 5
+    errs, _ = fd.check_slo(bad)
+    assert any("terminal_jobs 5" in e for e in errs)
+
+    # a terminal transition that escaped the plane (jobs rows win)
+    bad = _healthy_summary()
+    bad["jobs"].append({"job_id": "ghost", "state": "failed"})
+    errs, _ = fd.check_slo(bad)
+    assert any("escaped the SLO plane" in e for e in errs)
+
+    # forecast recommendation not derivable from its published inputs
+    bad = _healthy_summary()
+    bad["slo"]["forecast"]["recommended_workers"] = 7
+    errs, _ = fd.check_slo(bad)
+    assert any("re-derived" in e for e in errs)
+
+    # fleet drift: merged count != sum of shards
+    merged = merge_slo_sections({
+        "w0": _worker_section(0.0, [0.1]),
+        "w1": _worker_section(10.0, [0.2])})
+    merged["terminal_jobs"] = 3
+    errs, _ = fd.check_slo({"slo": merged})
+    assert any("sum of worker shards" in e for e in errs)
+
+    # no slo section at all
+    errs, _ = fd.check_slo({"jobs": []})
+    assert any("no slo section" in e for e in errs)
+
+
+def test_doctor_cli_slo_flag(tmp_path):
+    healthy = str(tmp_path / "ok.json")
+    with open(healthy, "w") as f:
+        json.dump(_healthy_summary(), f)
+    breached = _healthy_summary()
+    breached["slo"]["waterfalls"][0]["e2e_us"] += 1   # injected orphan
+    t = breached["slo"]["tenants"]["t0"]
+    t["burn"]["e2e_p95_s"] = 9.9                      # hidden breach
+    t["burn_max"] = 9.9
+    badp = str(tmp_path / "bad.json")
+    with open(badp, "w") as f:
+        json.dump(breached, f)
+    doctor = os.path.join(TOOLS, "flow_doctor.py")
+    ok = subprocess.run([sys.executable, doctor, "--slo", healthy],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stderr
+    bad = subprocess.run([sys.executable, doctor, "--slo", badp],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "does not reconstruct" in bad.stderr
+    assert "hiding" in bad.stderr
+
+
+# ---- trace_report lifecycle coverage -------------------------------
+
+def _lc(name, ts, **args):
+    return {"name": name, "ph": "i", "cat": "lifecycle", "s": "t",
+            "ts": ts, "pid": 1, "tid": 1, "args": args}
+
+
+def test_trace_report_lifecycle_coverage():
+    tr = _tool("trace_report")
+    full = {"traceEvents": [
+        _lc("route.trace.submit", 0.0, job_id="a"),
+        _lc("route.trace.admit", 1.0, job_id="a"),
+        _lc("route.trace.terminal", 2.0, job_id="a", state="done")]}
+    assert tr.check_lifecycle(full) == []
+    cov = tr.lifecycle_coverage(full)
+    assert cov["coverage"] == 1.0 and cov["terminal_jobs"] == 1
+    assert "lifecycle coverage: 1/1" in tr.summarize(full)
+    # an orphaned terminal (no origin) fails --check
+    torn = {"traceEvents": [
+        _lc("route.trace.admit", 1.0, job_id="a"),
+        _lc("route.trace.terminal", 2.0, job_id="a", state="done"),
+        _lc("route.trace.terminal", 3.0, job_id="ghost",
+            state="done")]}
+    errs = tr.check_lifecycle(torn)
+    assert len(errs) == 1 and "ghost" in errs[0]
+    assert "coverage 0.500" in errs[0]
+    # a trace that declares no lifecycle tracking is exempt
+    plain = {"traceEvents": [
+        {"name": "pack", "ph": "X", "cat": "stage", "ts": 0.0,
+         "dur": 5.0, "pid": 1, "tid": 1}]}
+    assert tr.lifecycle_coverage(plain) is None
+    assert tr.check_lifecycle(plain) == []
+    assert "lifecycle coverage" not in tr.summarize(plain)
+
+
+def test_daemon_trace_has_full_lifecycle_coverage(tmp_path):
+    from parallel_eda_tpu.obs.trace import Tracer
+    shard = str(tmp_path / "box" / "trace.solo.json")
+    set_tracer(Tracer(worker="solo"))
+    d, svc, clock = _mk_daemon(
+        tmp_path, opts=DaemonOpts(default_nets_per_s=10.0,
+                                  cold_start_factor=1.0,
+                                  exit_when_idle=1, trace_path=shard))
+    submit_job(d.inbox_dir, {"nets": 5, "name": "a"}, job_id="a")
+    d.run()
+    tr = _tool("trace_report")
+    doc = json.load(open(shard))
+    cov = tr.lifecycle_coverage(doc)
+    assert cov is not None and cov["coverage"] == 1.0
+    assert tr.check_lifecycle(doc) == []
+
+
+# ---- traffic_gen --objectives --------------------------------------
+
+def test_traffic_gen_objectives_deterministic(tmp_path):
+    tg = _tool("traffic_gen")
+
+    def run(seed, path):
+        argv = ["--inbox", str(tmp_path / f"box{seed}"),
+                "--jobs", "3", "--tenants", "2", "--seed", str(seed)]
+        args = tg.build_parser().parse_args(
+            argv + ["--objectives", path])
+        tg.write_objectives(path, tg.make_objectives(args))
+        return tg.make_stream(args)
+
+    p1 = str(tmp_path / "o1.json")
+    p2 = str(tmp_path / "o2.json")
+    plan = run(7, p1)
+    plan_again = run(7, p2)
+    # same seed: byte-identical fixture, identical submission plan
+    assert open(p1).read() == open(p2).read()
+    assert plan == plan_again
+    doc = json.load(open(p1))
+    assert set(doc["tenants"]) == {"t0", "t1"}
+    for obj in doc["tenants"].values():
+        assert 30.0 <= obj["e2e_p95_s"] <= 120.0
+        assert 0.01 <= obj["failure_rate"] <= 0.1
+        assert obj["budget_frac"] == 0.05
+    # the objectives draw from their OWN stream: the plan with no
+    # --objectives flag is the same plan
+    args = tg.build_parser().parse_args(
+        ["--inbox", str(tmp_path / "boxn"), "--jobs", "3",
+         "--tenants", "2", "--seed", "7"])
+    assert tg.make_stream(args) == plan
+    # a different seed moves the fixture
+    p3 = str(tmp_path / "o3.json")
+    run(8, p3)
+    assert open(p3).read() != open(p1).read()
+    # the daemon-side loader accepts the fixture
+    assert set(load_objectives(p1)) == {"t0", "t1"}
+
+
+# ---- observatory latency columns -----------------------------------
+
+def test_observatory_renders_latency_columns(tmp_path):
+    import io
+    from parallel_eda_tpu.obs import runstore as rs
+    runs = str(tmp_path / "runs")
+    new = rs.make_record("svc", {}, "nets_per_s", 5.0, "nets/s",
+                         "cpu", "cpu", tenant="t0", job_id="a",
+                         queue_wait_s=1.25, e2e_s=3.5, n_failovers=0)
+    old = rs.make_record("svc", {}, "nets_per_s", 4.0, "nets/s",
+                         "cpu", "cpu", tenant="t0", job_id="b")
+    rs.append_run(runs, new)
+    rs.append_run(runs, old)
+    obs = _tool("observatory")
+    buf = io.StringIO()
+    assert obs.print_report(rs, runs, out=buf) == 0
+    text = buf.getvalue()
+    assert "| q_wait_s | e2e_s | job |" in text
+    row_new = [ln for ln in text.splitlines() if "| a |" in ln][0]
+    assert "| 1.25 | 3.50 |" in row_new
+    # the old row stays valid and renders unknown latency as "-"
+    row_old = [ln for ln in text.splitlines() if "| b |" in ln][0]
+    assert "| - | - |" in row_old

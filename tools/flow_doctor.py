@@ -114,7 +114,7 @@ def check_trace(path: str) -> list:
     tr = _load_sibling("trace_report")
     doc = _read_json(path)
     return (tr.validate(doc) + tr.check_pipeline(doc)
-            + tr.check_counters(doc))
+            + tr.check_counters(doc) + tr.check_lifecycle(doc))
 
 
 def check_metrics(path: str) -> list:
@@ -911,6 +911,195 @@ def check_fleet_trace(doc: dict) -> tuple:
     return errs, notes
 
 
+def _load_slo():
+    """obs/slo.py by file path (same pattern as _load_runstore; the
+    SLO plane is deliberately stdlib-only so the doctor can gate a
+    summary anywhere it lands)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "parallel_eda_tpu", "obs", "slo.py")
+    spec = importlib.util.spec_from_file_location(
+        "slo", os.path.normpath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def check_slo(doc: dict) -> tuple:
+    """SLO rule set over a daemon or fleet summary JSON (the document
+    carries an ``slo`` section — SLOPlane.snapshot for one daemon,
+    merge_slo_sections output for a fleet).  Returns (errors, notes).
+    The rules hold the published SLO plane to its own arithmetic:
+
+      * every published waterfall satisfies the telescoping identity —
+        the integer stage sum (signed ``other`` residual included)
+        reconstructs ``e2e_us`` EXACTLY; an off-by-anything waterfall
+        means latency attribution silently lies;
+      * digests are self-consistent (declared count == bin sum) and
+        the e2e digest count equals ``terminal_jobs`` — one sample per
+        terminal job, never more, never fewer;
+      * on a daemon summary, terminal job rows (done/failed/timeout/
+        shed) reconcile with ``terminal_jobs + untracked_terminals``;
+      * per tenant, burn > 1.0 and membership in ``breached`` imply
+        each other BOTH ways (burn is fraction-over-budget, so breach
+        is definitional — disagreement means the publisher fudged one
+        side); ``burn_max`` must equal the max over the burn dict;
+      * on a fleet summary, the merged digest count equals the sum of
+        the per-worker shard counts (the exact bin-wise merge leaves
+        no room for drift), and merge errors are failures;
+      * the forecast is re-derivable: ``recommended_workers`` and
+        ``time_to_drain_s`` recompute exactly from the PUBLISHED
+        backlog_s / horizon_s / max_workers / workers_alive.
+    """
+    errs, notes = [], []
+    slo = doc.get("slo") if isinstance(doc, dict) else None
+    if not isinstance(slo, dict):
+        return (["slo: no slo section (a summary from before the SLO "
+                 "plane, or a disabled one)"], notes)
+    sl = _load_slo()
+    fleet = isinstance(slo.get("shards"), dict)
+    terminal = slo.get("terminal_jobs") or 0
+
+    # -- digests: self-consistent, count == terminal jobs
+    digests = {}
+    for key in ("digest_e2e", "digest_queue_wait"):
+        d = slo.get(key)
+        if not isinstance(d, dict):
+            if d is not None or not fleet:
+                errs.append(f"slo: {key} missing/malformed")
+            continue
+        try:
+            digests[key] = sl.QuantileDigest.from_dict(d)
+        except (ValueError, TypeError) as e:
+            errs.append(f"slo: {key} inconsistent: {e}")
+    for key, dig in digests.items():
+        if dig.count != terminal:
+            errs.append(f"slo: {key} count {dig.count} != "
+                        f"terminal_jobs {terminal} — a terminal job "
+                        f"was sampled twice or dropped")
+
+    # -- waterfalls: the exact telescoping identity
+    wfs = slo.get("waterfalls") or []
+    for wf in wfs:
+        if not isinstance(wf, dict) or not sl.waterfall_exact(wf):
+            jid = wf.get("job_id", "?") if isinstance(wf, dict) else "?"
+            stages = wf.get("stages_us") if isinstance(wf, dict) else None
+            total = sum(stages.values()) if isinstance(stages, dict) \
+                and all(isinstance(v, int) for v in stages.values()) \
+                else "?"
+            errs.append(f"slo: waterfall {jid}: stage sum {total} != "
+                        f"e2e_us {wf.get('e2e_us') if isinstance(wf, dict) else '?'}"
+                        f" — latency attribution does not reconstruct "
+                        f"the measured end-to-end")
+
+    # -- daemon summary: terminal rows reconcile with the plane
+    jobs = doc.get("jobs")
+    if not fleet and isinstance(jobs, list) and jobs:
+        n_rows = sum(1 for j in jobs if isinstance(j, dict)
+                     and j.get("state") in ("done", "failed",
+                                            "timeout", "shed"))
+        untracked = int(slo.get("untracked_terminals") or 0)
+        if terminal + untracked != n_rows:
+            errs.append(f"slo: {n_rows} terminal job row(s) but the "
+                        f"plane observed {terminal} (+{untracked} "
+                        f"untracked) — a terminal transition escaped "
+                        f"the SLO plane")
+        if untracked:
+            notes.append(f"slo: {untracked} untracked terminal(s) — "
+                         f"jobs that reached terminal without an "
+                         f"admit observation")
+
+    # -- per-tenant burn <-> breach, both directions
+    tenants = slo.get("tenants") or {}
+    for t, sec in sorted(tenants.items()):
+        if not isinstance(sec, dict):
+            errs.append(f"slo: tenant {t} section malformed")
+            continue
+        burn = sec.get("burn")
+        breached = set(sec.get("breached") or ())
+        if isinstance(burn, dict) and burn:
+            for k, v in sorted(burn.items()):
+                if v > 1.0 and k not in breached:
+                    errs.append(f"slo: tenant {t} objective {k} burn "
+                                f"{v} > 1 but not declared breached — "
+                                f"the budget is spent and the plane "
+                                f"is hiding it")
+                if v <= 1.0 and k in breached:
+                    errs.append(f"slo: tenant {t} objective {k} "
+                                f"declared breached at burn {v} <= 1 "
+                                f"— a false alarm is still an "
+                                f"inconsistent publisher")
+            bm = sec.get("burn_max")
+            if bm != max(burn.values()):
+                errs.append(f"slo: tenant {t} burn_max {bm} != "
+                            f"max(burn) {max(burn.values())}")
+        else:
+            # merged fleet sections carry worst-per-worker burn_max +
+            # the breached union, not the raw burn dict: the two must
+            # still imply each other across the > 1 boundary
+            bm = float(sec.get("burn_max") or 0.0)
+            if bm > 1.0 and not breached:
+                errs.append(f"slo: tenant {t} worst burn {bm} > 1 "
+                            f"with an empty breached set")
+            if breached and bm <= 1.0:
+                errs.append(f"slo: tenant {t} breached "
+                            f"{sorted(breached)} at worst burn {bm} "
+                            f"<= 1")
+
+    # -- fleet merge: exactness + surfaced merge errors
+    if fleet:
+        shards = slo["shards"]
+        tot = sum(int(v) for v in shards.values())
+        if tot != terminal:
+            errs.append(f"slo: merged terminal_jobs {terminal} != "
+                        f"sum of worker shards {tot} ({shards}) — "
+                        f"the bin-wise merge lost or invented samples")
+        dig = digests.get("digest_e2e")
+        if dig is not None and dig.count != tot:
+            errs.append(f"slo: merged e2e digest count {dig.count} "
+                        f"!= shard sum {tot}")
+        merrs = slo.get("errors")
+        if isinstance(merrs, dict):
+            for k, v in sorted(merrs.items()):
+                errs.append(f"slo: merge error [{k}]: {v}")
+
+    # -- forecast: re-derive the recommendation from published inputs
+    fc = slo.get("forecast")
+    if isinstance(fc, dict):
+        try:
+            backlog_s = float(fc["backlog_s"])
+            horizon = float(fc["horizon_s"])
+            cap = int(fc["max_workers"])
+            alive = max(1, int(fc.get("workers_alive") or 1))
+            rec = fc["recommended_workers"]
+            ttd = float(fc["time_to_drain_s"])
+        except (KeyError, TypeError, ValueError) as e:
+            errs.append(f"slo: forecast missing/malformed input: {e}")
+        else:
+            want = sl.recommended_workers(backlog_s, horizon, cap)
+            if rec != want:
+                errs.append(f"slo: recommended_workers {rec} != {want} "
+                            f"re-derived from published backlog_s="
+                            f"{backlog_s} horizon_s={horizon} "
+                            f"max_workers={cap}")
+            if ttd < 0 or backlog_s < 0:
+                errs.append(f"slo: negative forecast (backlog_s="
+                            f"{backlog_s}, time_to_drain_s={ttd})")
+            elif round(backlog_s / alive, 6) != round(ttd, 6):
+                errs.append(f"slo: time_to_drain_s {ttd} != backlog_s/"
+                            f"workers_alive {round(backlog_s / alive, 6)}")
+
+    breaches = sum(len(s.get("breached") or ()) for s in
+                   tenants.values() if isinstance(s, dict))
+    notes.append(
+        f"slo: {'fleet' if fleet else 'daemon'} section, "
+        f"{terminal} terminal job(s), {len(wfs)} waterfall(s), "
+        f"{len(tenants)} tenant(s), {breaches} breached objective(s)"
+        + (f", recommended_workers="
+           f"{fc.get('recommended_workers')}" if isinstance(fc, dict)
+           else ""))
+    return errs, notes
+
+
 def check_lint(root=None):
     """Run the graft-lint static rule set (parallel_eda_tpu/analysis —
     stdlib-only like this tool) over the source tree.  Every live
@@ -999,6 +1188,13 @@ def main(argv=None) -> int:
                          "set (skew bound, contiguous per-job "
                          "lifecycle chains, steal-linked failovers, "
                          "no orphaned slice spans, coded verdicts)")
+    ap.add_argument("--slo", dest="slo",
+                    help="daemon or fleet summary JSON to gate with "
+                         "the SLO rule set (exact waterfall stage "
+                         "sums, digest count == terminal jobs, "
+                         "burn > 1 <-> breached both ways, merged "
+                         "digest == sum of worker shards, forecast "
+                         "re-derivable from its published inputs)")
     ap.add_argument("--lint", action="store_true",
                     help="run the graft-lint static rule set over the "
                          "source tree (donation safety, signature "
@@ -1011,11 +1207,12 @@ def main(argv=None) -> int:
 
     if not any((args.trace, args.metrics, args.devprof, args.row,
                 args.corpus, args.serve_summary, args.daemon_summary,
-                args.fleet_summary, args.fleet_trace, args.lint)):
+                args.fleet_summary, args.fleet_trace, args.slo,
+                args.lint)):
         ap.error("nothing to check: give at least one of --trace / "
                  "--metrics / --devprof / --row / --corpus / "
                  "--serve-summary / --daemon-summary / "
-                 "--fleet-summary / --fleet-trace / --lint")
+                 "--fleet-summary / --fleet-trace / --slo / --lint")
 
     errs, notes = [], []
     try:
@@ -1093,6 +1290,10 @@ def main(argv=None) -> int:
             te, tn = check_fleet_trace(_read_json(args.fleet_trace))
             errs += te
             notes += tn
+        if args.slo:
+            se, sn = check_slo(_read_json(args.slo))
+            errs += se
+            notes += sn
         if args.lint:
             le, ln = check_lint(args.lint_root)
             errs += le

@@ -218,11 +218,15 @@ def print_report(rs, runs_dir: str, scenario=None, last: int = 10,
                 print(f"\n### tenant {tenant}  ({len(grecs)} run(s))",
                       file=out)
             jobs = tenant is not None
+            # the latency columns are the runstore's OPTIONAL v2 SLO
+            # fields (absent => unknown, rendered "-"): old rows keep
+            # their width so a corpus spanning eras still tables
             print("| ts | git | backend | device | metric | value | "
                   "wirelength | iters | era |"
-                  + (" job |" if jobs else ""), file=out)
+                  + (" q_wait_s | e2e_s | job |" if jobs else ""),
+                  file=out)
             print("|---|---|---|---|---|---|---|---|---|"
-                  + ("---|" if jobs else ""), file=out)
+                  + ("---|---|---|" if jobs else ""), file=out)
             for r in grecs[-last:]:
                 qor = r.get("qor") or {}
                 era = "pre_pr2" if (r.get("tags") or {}).get("pre_pr2") \
@@ -234,7 +238,9 @@ def print_report(rs, runs_dir: str, scenario=None, last: int = 10,
                         f"| {_fmt(qor.get('wirelength'))} "
                         f"| {_fmt(qor.get('iterations'))} | {era} |")
                 if jobs:
-                    line += f" {r.get('job_id') or '-'} |"
+                    line += (f" {_fmt(r.get('queue_wait_s'))} "
+                             f"| {_fmt(r.get('e2e_s'))} "
+                             f"| {r.get('job_id') or '-'} |")
                 print(line, file=out)
         pair = pick_attribution_pair(recs)
         if pair is None:

@@ -192,6 +192,68 @@ def check_pipeline(doc) -> list:
     return errs
 
 
+def _lifecycle(doc):
+    return [e for e in doc.get("traceEvents", [])
+            if isinstance(e, dict) and e.get("cat") == "lifecycle"]
+
+
+def lifecycle_coverage(doc):
+    """Lifecycle-chain coverage: of the jobs that reached a terminal
+    instant (route.trace.terminal), how many carry a complete chain —
+    an origin instant (route.trace.submit or route.trace.admit, the
+    two ways work enters a daemon) under the SAME job_id.
+
+    Returns None when the trace declares no lifecycle tracking (no
+    cat="lifecycle" event at all: a plain flow trace, not a serve
+    run).  Otherwise a dict with terminal/complete counts, coverage
+    in [0, 1], and the orphaned job_ids (terminal but origin-less)."""
+    evs = _lifecycle(doc)
+    if not evs:
+        return None
+
+    def _jid(e):
+        a = e.get("args")
+        return a.get("job_id") if isinstance(a, dict) else None
+
+    origins, terminals = set(), set()
+    for e in evs:
+        jid = _jid(e)
+        if jid is None:
+            continue
+        name = e.get("name")
+        if name in ("route.trace.submit", "route.trace.admit"):
+            origins.add(jid)
+        elif name == "route.trace.terminal":
+            terminals.add(jid)
+    orphans = sorted(str(j) for j in terminals - origins)
+    n_term = len(terminals)
+    return {"terminal_jobs": n_term,
+            "complete_chains": n_term - len(orphans),
+            "coverage": ((n_term - len(orphans)) / n_term)
+            if n_term else 1.0,
+            "orphans": orphans}
+
+
+def check_lifecycle(doc) -> list:
+    """Lifecycle-coverage invariant for --check: a trace that declares
+    lifecycle tracking (any cat="lifecycle" event) must show coverage
+    == 1.0 — every job with a terminal instant also carries its
+    submit/admit origin.  An orphaned terminal means the chain was
+    torn (a dropped submit instant, a trace started mid-run, or a
+    merge that lost a worker's shard) and per-job latency attribution
+    silently undercounts."""
+    cov = lifecycle_coverage(doc)
+    if cov is None or cov["coverage"] >= 1.0:
+        return []
+    head = ", ".join(cov["orphans"][:5])
+    more = "" if len(cov["orphans"]) <= 5 else \
+        f" (+{len(cov['orphans']) - 5} more)"
+    return [
+        f"lifecycle coverage {cov['coverage']:.3f} < 1.0: "
+        f"{len(cov['orphans'])} of {cov['terminal_jobs']} terminal "
+        f"job(s) have no submit/admit origin instant: {head}{more}"]
+
+
 def _counters(doc):
     return [e for e in doc.get("traceEvents", [])
             if isinstance(e, dict) and e.get("ph") == "C"]
@@ -325,6 +387,15 @@ def summarize(doc) -> str:
             f"{ov['windows']} windows, {ov['exec_spans']} exec / "
             f"{ov['plan_spans']} plan spans)")
 
+    cov = lifecycle_coverage(doc)
+    if cov is not None:
+        orphan = "" if not cov["orphans"] else \
+            f" ({len(cov['orphans'])} orphaned)"
+        lines.append(
+            f"lifecycle coverage: {cov['complete_chains']}/"
+            f"{cov['terminal_jobs']} terminal job(s) with a complete "
+            f"submit->terminal chain ({cov['coverage']:.1%}){orphan}")
+
     cs = _counters(doc)
     declared = doc.get("declaredCounterTracks")
     if cs:
@@ -391,7 +462,8 @@ def main(argv=None) -> int:
         print(f"MALFORMED: {e}", file=sys.stderr)
         return 2
 
-    errs = validate(doc) + check_pipeline(doc) + check_counters(doc)
+    errs = (validate(doc) + check_pipeline(doc) + check_counters(doc)
+            + check_lifecycle(doc))
     if args.check:
         if errs:
             print("MALFORMED trace:", file=sys.stderr)

@@ -78,7 +78,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retries", type=int, default=4,
                    help="transport client attempt cap")
     p.add_argument("--timeout_s", type=float, default=10.0)
+    p.add_argument("--objectives", default="",
+                   help="also write a seeded per-tenant SLO objectives "
+                   "JSON here (atomic, BEFORE any delivery — the "
+                   "plan-first contract): the fixture `daemon run "
+                   "--objectives` and flow_doctor --slo consume")
     return p
+
+
+def make_objectives(args) -> dict:
+    """Seeded per-tenant objectives, drawn from their OWN RNG stream
+    (seed+1) so adding --objectives never perturbs the submission
+    plan.  Same seed, same fixture, byte for byte."""
+    rng = random.Random(args.seed + 1)
+    tenants = {}
+    for i in range(args.tenants):
+        tenants[f"t{i}"] = {
+            "e2e_p95_s": round(rng.uniform(30.0, 120.0), 3),
+            "queue_wait_p95_s": round(rng.uniform(5.0, 30.0), 3),
+            "failure_rate": round(rng.uniform(0.01, 0.1), 4),
+            "budget_frac": 0.05,
+        }
+    return {"schema": 1, "seed": args.seed, "tenants": tenants}
+
+
+def write_objectives(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def make_stream(args) -> list:
@@ -124,6 +154,10 @@ def make_stream(args) -> list:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     stream = make_stream(args)
+    if args.objectives:
+        # fixture lands durably BEFORE the first submission: a daemon
+        # started against it never races the stream's arrival
+        write_objectives(args.objectives, make_objectives(args))
     url = args.url
     if url.startswith("@"):
         with open(url[1:]) as f:
@@ -169,6 +203,7 @@ def main(argv=None) -> int:
         "submitted": submitted,
         "submit_walls": submit_walls,
         "per_tenant": per_tenant,
+        "objectives": args.objectives or None,
         "transport_retries": client.retries if client else 0,
         "wall_s": round(time.perf_counter() - t0, 3),
     }, sort_keys=True))
